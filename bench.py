@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Benchmark: steady-state training throughput of the flagship MNIST CNN.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Protocol (BASELINE.md): examples/sec/chip for the sync engine on all local
+devices; the measurement window excludes compilation (warmup steps first),
+matching the "steady state" row of the reference-derived metrics.  The
+reference publishes no numbers (BASELINE.md §published: none), so
+``vs_baseline`` is computed against ``bench_baseline.json`` — our own first
+recorded measurement — and defaults to 1.0 until that file exists.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+PER_CHIP_BATCH = 512
+
+
+def main() -> None:
+    import jax
+
+    from distributed_tensorflow_tpu.data.loaders import load_dataset
+    from distributed_tensorflow_tpu.engines import SyncEngine
+    from distributed_tensorflow_tpu.models import create_model
+    from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+    mesh = meshlib.create_mesh()
+    n = mesh.shape[meshlib.DATA_AXIS]
+    global_batch = PER_CHIP_BATCH * n
+
+    ds = load_dataset("mnist", split="train")
+    model = create_model("cnn", num_classes=ds.num_classes)
+    eng = SyncEngine(model, mesh=mesh)
+
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, len(ds.x), global_batch)
+    x, y = ds.x[idx], ds.y[idx]
+
+    state = eng.init_state(jax.random.key(0), x[:n])
+    xs, ys = eng.shard_batch(x, y)
+
+    for _ in range(WARMUP_STEPS):
+        state, m = eng.step(state, xs, ys)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, m = eng.step(state, xs, ys)
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+
+    eps = MEASURE_STEPS * global_batch / elapsed
+    eps_per_chip = eps / n
+
+    baseline_path = Path(__file__).parent / "bench_baseline.json"
+    vs = 1.0
+    if baseline_path.exists():
+        base = json.loads(baseline_path.read_text()).get("examples_per_sec_per_chip")
+        if base:
+            vs = eps_per_chip / base
+
+    print(json.dumps({
+        "metric": "mnist_cnn_sync_examples_per_sec_per_chip",
+        "value": round(eps_per_chip, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

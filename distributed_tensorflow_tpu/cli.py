@@ -1,0 +1,139 @@
+"""L4 CLI/launcher — flag-compatible with the reference's initializer.py.
+
+Reference surface (reference initializer.py:72-114):
+  -m/--mode {c,centralized,d,decentralized}   -cs {sync,async}
+  -ds {keras,graph,custom}   -n N   -b B   -tt {server,worker}   -ti I
+  -sa ADDR   -ca {y,n}
+
+Mapping to TPU-native engines (no processes are spawned — one SPMD program
+owns all local devices; compare reference initializer.py:134-145 which forks
+N+1 processes):
+
+  -m c  -cs sync    → sync engine      (parameter-server sync semantics)
+  -m c  -cs async   → async engine     (local SGD, periodic averaging)
+  -m d  -ds keras   → allreduce engine (RING-allreduce semantics)
+  -m d  -ds graph   → gossip engine    (implemented — ref raises
+  -m d  -ds custom  → gossip engine     NotImplementedError, init.py:175-181)
+  -m t/tpu_pod      → sync engine      (BASELINE.json north-star mode)
+
+``-n`` selects TPU device count (BASELINE.json: "-n maps to device count");
+``-b`` stays the per-worker batch, so the global batch is b×n like the
+reference's aggregate.  ``-ca`` is accepted-and-ignored: core pinning
+simulated "1 node = 1 core" (reference server.py:144-146), and a TPU device
+*is* the node here.  ``-tt/-ti/-sa`` become `jax.distributed.initialize`
+coordinates for real multi-host pods.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from distributed_tensorflow_tpu.utils.harness import ExperimentConfig, run
+
+
+def str2bool(v: str) -> bool:
+    """Parity with reference str2bool (reference initializer.py:59-67)."""
+    if isinstance(v, bool):
+        return v
+    if v.lower() in ("yes", "true", "t", "y", "1"):
+        return True
+    if v.lower() in ("no", "false", "f", "n", "0"):
+        return False
+    raise argparse.ArgumentTypeError("Boolean value expected.")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="distributed_tensorflow_tpu",
+        description="TPU-native distributed training (reference-flag compatible)")
+    p.add_argument("-m", "--mode", default="tpu_pod",
+                   choices=["c", "centralized", "d", "decentralized", "t", "tpu_pod"])
+    p.add_argument("-cs", "--centralized_strategy", default="sync",
+                   choices=["sync", "async"])
+    p.add_argument("-ds", "--decentralized_strategy", default="keras",
+                   choices=["keras", "graph", "custom", "sync"])
+    p.add_argument("-n", "--number_nodes", type=int, default=None,
+                   help="TPU device count (default: all local devices)")
+    p.add_argument("-b", "--batch_size", type=int, default=32,
+                   help="per-worker batch; global batch = b × n")
+    p.add_argument("-tt", "--task_type", default=None, choices=["server", "worker"],
+                   help="multi-host role (server == coordinator host)")
+    p.add_argument("-ti", "--task_index", type=int, default=0)
+    p.add_argument("-sa", "--server_address", default=None,
+                   help="coordinator address host:port for multi-host")
+    p.add_argument("-ca", "--cpu_affinity", type=str2bool, nargs="?", const=True,
+                   default=False, help="accepted for compatibility; no-op on TPU")
+    # TPU-native additions
+    p.add_argument("--model", default="mlp",
+                   help="registered model name (mlp|cnn|resnet20|bert_tiny)")
+    p.add_argument("--dataset", default="mnist",
+                   help="mnist|fashion_mnist|cifar10|synthetic")
+    p.add_argument("-e", "--epochs", type=int, default=1,
+                   help="reference hardwires 1 (SURVEY.md §2.4(6))")
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--sync-every", type=int, default=10,
+                   help="async engine: parameter-averaging period")
+    p.add_argument("-d", "--degree", type=int, default=1,
+                   help="gossip neighbor degree (the reference's commented-out "
+                        "-d flag, initializer.py:90-92)")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="multi-host: total process count")
+    p.add_argument("--result-path", default=None, help="JSONL event sink path")
+    p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def select_engine(args: argparse.Namespace) -> str:
+    if args.mode in ("c", "centralized"):
+        return "sync" if args.centralized_strategy == "sync" else "async"
+    if args.mode in ("d", "decentralized"):
+        if args.decentralized_strategy in ("graph", "custom"):
+            return "gossip"
+        if args.decentralized_strategy == "sync":
+            return "sync"
+        return "allreduce"
+    return "sync"  # tpu_pod
+
+
+def main(argv: list[str] | None = None) -> dict:
+    args = build_parser().parse_args(argv)
+
+    if args.task_type is not None and args.server_address is not None:
+        # multi-host pod: same SPMD program on every host, coordinated by
+        # process 0 — replaces the reference's role-per-machine dispatch
+        # (reference initializer.py:147-155)
+        from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+        # process 0 is the coordinator ('server' role); worker i maps to
+        # process i+1, so '-tt worker -ti 0' does not collide with the server
+        meshlib.multihost_initialize(
+            coordinator_address=args.server_address,
+            num_processes=args.num_processes,
+            process_id=args.task_index + 1 if args.task_type == "worker" else 0,
+        )
+
+    config = ExperimentConfig(
+        engine=select_engine(args),
+        model=args.model,
+        dataset=args.dataset,
+        n_devices=args.number_nodes,
+        batch_size=args.batch_size,
+        epochs=args.epochs,
+        learning_rate=args.lr,
+        sync_every=args.sync_every,
+        degree=args.degree,
+        seed=args.seed,
+        log_every=args.log_every,
+        result_path=args.result_path,
+        supervisor_address=None,
+    )
+    summary = run(config)
+    print(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""L3 data plug-in point.
+
+The reference's contract is ``dataset_fn(batch_size, type='train'|'test',
+shard=True, index=0, buffer_size=10000, reshape=True) -> tf.data.Dataset``
+(reference initializer.py:24-55).  Here the same signature yields a
+:class:`Dataset` of host numpy arrays; batching/sharding happens in the
+pipeline (shuffle *examples* then batch — deliberately fixing the
+reference's batch-before-shuffle quirk, reference initializer.py:44-45 /
+SURVEY.md §2.4(5)) and device placement happens in the engine via
+``NamedSharding`` rather than per-process `.shard()` calls.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from distributed_tensorflow_tpu.data.loaders import (
+    Dataset,
+    load_dataset,
+)
+from distributed_tensorflow_tpu.data.pipeline import iter_batches  # noqa: F401
+
+
+def make_dataset_fn(name: str, **load_kw) -> Callable[..., Dataset]:
+    """Build a reference-signature dataset_fn for a named dataset.
+
+    ``shard``/``index`` reproduce `tf.data ... .shard(n_nodes, index)`
+    semantics (reference initializer.py:44) for multi-host runs, but with the
+    shard count passed explicitly (``n_shards``) instead of the reference's
+    fork-inherited module global (SURVEY.md §2.4(5)).
+    """
+
+    def dataset_fn(
+        batch_size: int,
+        type: str = "train",
+        shard: bool = False,
+        index: int = 0,
+        buffer_size: int = 10000,
+        reshape: bool = True,
+        n_shards: int = 1,
+    ) -> Dataset:
+        ds = load_dataset(name, split=type, reshape=reshape, **load_kw)
+        if shard and n_shards > 1:
+            ds = ds.shard(n_shards, index)
+        ds = ds.with_batching(batch_size=batch_size, buffer_size=buffer_size)
+        return ds
+
+    dataset_fn.__name__ = f"dataset_fn_{name}"
+    return dataset_fn

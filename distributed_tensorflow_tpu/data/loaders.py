@@ -1,0 +1,178 @@
+"""Dataset loading: local archives when present, deterministic synthetic
+fallback otherwise (this environment has zero egress — nothing downloads).
+
+Real-data formats understood:
+  mnist / fashion_mnist  — keras-style ``.npz`` with x_train/y_train/x_test/y_test
+  cifar10                — either ``cifar10.npz`` (same keys) or the original
+                           ``cifar-10-batches-py`` pickle directory
+
+Search order: $DTF_TPU_DATA_DIR, ~/.keras/datasets, ./datasets, /root/data.
+The synthetic fallback draws each example from a fixed per-class prototype
+plus noise, so models genuinely *learn* (accuracy targets in tests are
+meaningful), and is deterministic in (name, split).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+from pathlib import Path
+
+import numpy as np
+
+_SHAPES = {
+    "mnist": ((28, 28), 10),
+    "fashion_mnist": ((28, 28), 10),
+    "cifar10": ((32, 32, 3), 10),
+}
+
+
+@dataclasses.dataclass
+class Dataset:
+    """Host-side dataset: plain numpy, batched lazily by the pipeline."""
+
+    x: np.ndarray
+    y: np.ndarray
+    num_classes: int
+    name: str = "dataset"
+    synthetic: bool = False
+    batch_size: int | None = None
+    buffer_size: int = 10000
+
+    def __len__(self) -> int:
+        return len(self.x)
+
+    def shard(self, n_shards: int, index: int) -> "Dataset":
+        """Every n-th example, like `tf.data .shard` (reference initializer.py:44)."""
+        return dataclasses.replace(
+            self, x=self.x[index::n_shards], y=self.y[index::n_shards]
+        )
+
+    def with_batching(self, batch_size: int, buffer_size: int = 10000) -> "Dataset":
+        return dataclasses.replace(
+            self, batch_size=batch_size, buffer_size=buffer_size
+        )
+
+    def batches(self, batch_size: int | None = None, *, shuffle: bool = True,
+                seed: int = 0, epoch: int = 0, drop_remainder: bool = False):
+        from distributed_tensorflow_tpu.data.pipeline import iter_batches
+
+        bs = batch_size or self.batch_size
+        if bs is None:
+            raise ValueError("batch_size not set; pass it or use with_batching()")
+        return iter_batches(
+            self.x, self.y, bs, shuffle=shuffle, seed=seed, epoch=epoch,
+            drop_remainder=drop_remainder,
+        )
+
+
+def _search_dirs() -> list[Path]:
+    dirs = []
+    if os.environ.get("DTF_TPU_DATA_DIR"):
+        dirs.append(Path(os.environ["DTF_TPU_DATA_DIR"]))
+    dirs += [
+        Path.home() / ".keras" / "datasets",
+        Path("datasets"),
+        Path("/root/data"),
+    ]
+    return [d for d in dirs if d.is_dir()]
+
+
+def _find(*names: str) -> Path | None:
+    for d in _search_dirs():
+        for n in names:
+            p = d / n
+            if p.exists():
+                return p
+    return None
+
+
+def _load_npz(path: Path, split: str):
+    with np.load(path, allow_pickle=False) as f:
+        if split == "train":
+            return f["x_train"], f["y_train"]
+        return f["x_test"], f["y_test"]
+
+
+def _load_cifar_batches(path: Path, split: str):
+    def one(p: Path):
+        with open(p, "rb") as fh:
+            d = pickle.load(fh, encoding="bytes")
+        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return x, np.asarray(d[b"labels"])
+
+    if split == "train":
+        parts = [one(path / f"data_batch_{i}") for i in range(1, 6)]
+        return (np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]))
+    return one(path / "test_batch")
+
+
+def synthetic_classification(
+    shape: tuple[int, ...],
+    num_classes: int,
+    n: int,
+    seed: int,
+    split: str = "train",
+    noise: float = 0.35,
+):
+    """Per-class Gaussian prototypes + noise: learnable, deterministic.
+
+    Prototypes depend only on ``seed`` (shared across splits); the noise and
+    label draws are keyed by (seed, split) so train/test are disjoint samples
+    of the same underlying task.
+    """
+    proto_rng = np.random.default_rng(seed)
+    protos = proto_rng.normal(0.5, 0.25, size=(num_classes, *shape)).clip(0, 1)
+    rng = np.random.default_rng((seed, 0 if split == "train" else 1))
+    y = rng.integers(0, num_classes, size=n).astype(np.int32)
+    x = protos[y] + rng.normal(0.0, noise, size=(n, *shape))
+    return x.clip(0.0, 1.0).astype(np.float32), y
+
+
+def load_dataset(
+    name: str,
+    split: str = "train",
+    reshape: bool = True,
+    n_synthetic_train: int = 8192,
+    n_synthetic_test: int = 2048,
+) -> Dataset:
+    """Load a named dataset; silently fall back to synthetic when no local copy.
+
+    ``reshape`` mirrors the reference's flag (reference initializer.py:28-35):
+    True adds a trailing channel dim to 2-D images ((28,28) → (28,28,1)).
+    """
+    if name in ("synthetic", "synth"):
+        name, shape, ncls, path = "synthetic", (28, 28), 10, None
+    elif name in _SHAPES:
+        shape, ncls = _SHAPES[name]
+        if name == "mnist":
+            path = _find("mnist.npz")
+        elif name == "fashion_mnist":
+            path = _find("fashion_mnist.npz", "fashion-mnist.npz")
+        else:
+            path = _find("cifar10.npz") or _find("cifar-10-batches-py")
+    else:
+        raise KeyError(f"unknown dataset '{name}'; known: {sorted(_SHAPES)} + synthetic")
+
+    if path is not None:
+        if path.is_dir():
+            x, y = _load_cifar_batches(path, split)
+        else:
+            x, y = _load_npz(path, split)
+        x = x.astype(np.float32) / 255.0
+        synthetic = False
+    else:
+        n = n_synthetic_train if split == "train" else n_synthetic_test
+        # stable per-dataset seed (hash() is salted per process — don't use it)
+        seed = sum(ord(c) for c in name) * 1000003 % (2**31)
+        x, y = synthetic_classification(shape, ncls, n, seed, split=split)
+        synthetic = True
+
+    if reshape and x.ndim == 3:  # (N,28,28) → (N,28,28,1), reference initializer.py:28-29
+        x = x[..., None]
+    return Dataset(
+        x=x, y=y.astype(np.int32), num_classes=ncls,
+        name=name, synthetic=synthetic,
+    )

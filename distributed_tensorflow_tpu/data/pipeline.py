@@ -1,0 +1,57 @@
+"""Host input pipeline: shuffle examples, then batch.
+
+Deliberately fixes two reference quirks (SURVEY.md §2.4(5)): the reference
+batches *before* shuffling (so it shuffles batches, reference
+initializer.py:44-45) and reads the shard count from a fork-inherited module
+global (reference initializer.py:44 vs :119).  Here shuffling is
+example-level with a per-epoch deterministic permutation, and all sharding
+parameters are explicit.
+
+Batches are yielded as (x, y, mask): ``mask`` flags padding rows added so
+every batch divides evenly over the device mesh — eval stays exact without
+dropping the remainder (the reference's server-side eval also uses the full
+test set, reference server.py:24-37, 179-180).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+Batch = tuple[np.ndarray, np.ndarray, np.ndarray]
+
+
+def iter_batches(
+    x: np.ndarray,
+    y: np.ndarray,
+    batch_size: int,
+    *,
+    shuffle: bool = True,
+    seed: int = 0,
+    epoch: int = 0,
+    drop_remainder: bool = False,
+) -> Iterator[Batch]:
+    n = len(x)
+    idx = np.arange(n)
+    if shuffle:
+        rng = np.random.default_rng((seed, epoch))
+        rng.shuffle(idx)
+    for start in range(0, n, batch_size):
+        take = idx[start : start + batch_size]
+        if len(take) < batch_size:
+            if drop_remainder:
+                return
+            bx, by = x[take], y[take]
+            mask = np.ones(len(take), dtype=np.float32)
+            pad = batch_size - len(take)
+            bx = np.concatenate([bx, np.zeros((pad, *x.shape[1:]), x.dtype)])
+            by = np.concatenate([by, np.zeros(pad, y.dtype)])
+            mask = np.concatenate([mask, np.zeros(pad, np.float32)])
+            yield bx, by, mask
+            return
+        yield x[take], y[take], np.ones(batch_size, dtype=np.float32)
+
+
+def steps_per_epoch(n: int, batch_size: int, drop_remainder: bool = False) -> int:
+    return n // batch_size if drop_remainder else -(-n // batch_size)

@@ -1,0 +1,37 @@
+"""L2 step engines — TPU-native renderings of the reference's four training
+modes (SURVEY.md §7.2(3)):
+
+  sync       — sync data parallelism: per-device grads, `pmean`, one global
+               optimizer step.  Replaces the sync parameter server
+               (reference server.py:90-96 + client.py:78-95); the "server"
+               disappears — optimizer state is replicated on every device.
+  async      — local-update data parallelism: per-device optimizer steps every
+               batch, parameter averaging every K steps.  The honest SPMD
+               rendering of the reference's Hogwild-at-the-optimizer async PS
+               (reference server.py:98-102; SURVEY.md §2.4(2)).
+  allreduce  — identical math to sync, exposed through a Keras-fit-like
+               Trainer (replaces MultiWorkerMirroredStrategy + model.fit,
+               reference dist_keras.py:22-58).
+  gossip     — ring/graph neighbor averaging via `ppermute`, implementing for
+               real the reference's NotImplementedError 'graph'/'custom'
+               strategies (reference initializer.py:175-181).
+"""
+
+from distributed_tensorflow_tpu.engines.base import Engine, TrainState  # noqa: F401
+from distributed_tensorflow_tpu.engines.sync import SyncEngine  # noqa: F401
+from distributed_tensorflow_tpu.engines.async_local import AsyncLocalEngine  # noqa: F401
+from distributed_tensorflow_tpu.engines.gossip import GossipEngine  # noqa: F401
+from distributed_tensorflow_tpu.engines.allreduce import Trainer  # noqa: F401
+
+ENGINES = {
+    "sync": SyncEngine,
+    "async": AsyncLocalEngine,
+    "allreduce": SyncEngine,
+    "gossip": GossipEngine,
+}
+
+
+def create_engine(name: str, *args, **kw):
+    if name not in ENGINES:
+        raise KeyError(f"unknown engine '{name}'; known: {sorted(ENGINES)}")
+    return ENGINES[name](*args, **kw)

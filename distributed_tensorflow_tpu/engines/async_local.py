@@ -1,0 +1,89 @@
+"""Async-flavored data parallelism: local optimizer steps + periodic averaging.
+
+The reference's async PS is Hogwild-at-the-optimizer — lock-serialized
+`apply_gradients` with no round structure; workers train on whatever weights
+exist at pull time (reference server.py:98-102, SURVEY.md §2.4(2)).  True
+asynchrony doesn't map onto a bulk-synchronous SPMD mesh, so the honest
+TPU-native rendering (SURVEY.md §7.4) is *local SGD*: every device keeps its
+own parameters and optimizer state, applies its own gradient every batch
+(exactly as stale as one async round), and parameters are averaged across the
+mesh every ``sync_every`` steps via `pmean`.
+
+Layout: the whole TrainState is *stacked* — every leaf gains a leading
+device axis sharded over ``data``, so device i owns row i.  Inside shard_map
+each device sees a size-1 leading axis which we strip/restore.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.engines.base import Engine, TrainState, make_loss_fn
+from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+
+class AsyncLocalEngine(Engine):
+    def __init__(self, *args, sync_every: int = 10, **kw):
+        super().__init__(*args, **kw)
+        self.sync_every = sync_every
+
+    # state is per-device: every leaf stacked along a leading device axis
+    def init_state(self, rng, sample_x) -> TrainState:
+        params = self.model.init(rng, jnp.asarray(sample_x[:1]), train=False)["params"]
+        opt_state = self.tx.init(params)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           opt_state=opt_state, rng=rng)
+        n = self.n_devices
+        stacked = jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (n, *jnp.shape(a))), state)
+        return jax.device_put(stacked, meshlib.per_device_sharding(self.mesh))
+
+    def _build_step(self):
+        loss_fn = make_loss_fn(self.model.apply)
+        tx, axis, sync_every = self.tx, self.axis, self.sync_every
+
+        def device_step(state_1: TrainState, x, y):
+            s = jax.tree.map(lambda a: a[0], state_1)  # strip size-1 device axis
+            rng = self._per_device_rng(s.rng, s.step)
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                s.params, x, y, rng)
+            # local apply — the analogue of one lock-serialized async update
+            updates, opt_state = tx.update(grads, s.opt_state, s.params)
+            params = optax.apply_updates(s.params, updates)
+            step = s.step + 1
+            do_sync = (step % sync_every) == 0
+            # periodic parameter averaging (the "weight exchange"); predicate
+            # is device-invariant so all devices enter the collective together
+            params = jax.lax.cond(
+                do_sync,
+                lambda p: coll.all_reduce_mean(p, axis),
+                lambda p: p,
+                params,
+            )
+            metrics = coll.all_reduce_mean({"loss": loss, "accuracy": acc}, axis)
+            new_s = s.replace(step=step, params=params, opt_state=opt_state)
+            return jax.tree.map(lambda a: a[None], new_s), metrics
+
+        smapped = jax.shard_map(
+            device_step, mesh=self.mesh,
+            in_specs=(P(self.axis), P(self.axis), P(self.axis)),
+            out_specs=(P(self.axis), P()),
+            check_vma=False,  # step is replicated in value; vma can't see that
+        )
+        return jax.jit(smapped, donate_argnums=0)
+
+    def eval_params(self, state: TrainState):
+        """Average the per-device parameter copies for evaluation (the final
+        'consensus' model — comparable to the async PS's single server model)."""
+
+        @jax.jit
+        def mean_params(p):
+            return jax.tree.map(lambda a: a.mean(axis=0), p)
+
+        return jax.device_put(mean_params(state.params),
+                              meshlib.replicated(self.mesh))

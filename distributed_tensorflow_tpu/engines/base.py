@@ -1,0 +1,156 @@
+"""Shared engine machinery: TrainState, loss, eval, batch placement.
+
+Design: every engine is a single jitted SPMD program over a Mesh.  There is
+no server process and no wire — where the reference moves pickled gradients
+and weights over TCP every batch (reference client.py:85-90,
+server.py:86-107), we move nothing off-device: XLA collectives combine
+gradients/parameters across the mesh's ``data`` axis in-graph.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+PyTree = Any
+
+
+@struct.dataclass
+class TrainState:
+    """Replaces the reference server's (model, optimizer) pair
+    (reference server.py:148-155) as a pure value."""
+
+    step: jax.Array
+    params: PyTree
+    opt_state: PyTree
+    rng: jax.Array
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Sparse categorical crossentropy from logits — parity with the
+    reference's loss (reference server.py:13-15, client.py:11-13)."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+def make_loss_fn(apply_fn: Callable) -> Callable:
+    def loss_fn(params, x, y, rng):
+        logits = apply_fn({"params": params}, x, train=True, rngs={"dropout": rng})
+        loss = cross_entropy(logits, y).mean()
+        acc = (logits.argmax(-1) == y).mean()
+        return loss, acc
+
+    return loss_fn
+
+
+class Engine:
+    """Base: owns model, optimizer, mesh; subclasses build the step program."""
+
+    axis = meshlib.DATA_AXIS
+
+    def __init__(
+        self,
+        model,
+        optimizer: optax.GradientTransformation | None = None,
+        mesh=None,
+        learning_rate: float = 1e-3,
+    ):
+        self.model = model
+        self.tx = optimizer if optimizer is not None else optax.adam(learning_rate)
+        self.mesh = mesh if mesh is not None else meshlib.create_mesh()
+        self.n_devices = self.mesh.shape[self.axis]
+        self._step_fn = None
+        self._eval_fn = None
+
+    # ---------------------------------------------------------------- init
+    def init_state(self, rng: jax.Array, sample_x: np.ndarray) -> TrainState:
+        """Initialize replicated state (subclasses may re-layout)."""
+        params = self.model.init(rng, jnp.asarray(sample_x[:1]), train=False)["params"]
+        opt_state = self.tx.init(params)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           opt_state=opt_state, rng=rng)
+        return jax.device_put(state, meshlib.replicated(self.mesh))
+
+    # ------------------------------------------------------------- batches
+    def shard_batch(self, x: np.ndarray, y: np.ndarray, mask: np.ndarray | None = None):
+        """Place a global batch with its leading dim split over the data axis.
+
+        Replaces per-worker dataset sharding (reference initializer.py:44):
+        one host batch feeds all devices.
+        """
+        xs = jax.device_put(x, meshlib.data_sharding(self.mesh, x.ndim))
+        ys = jax.device_put(y, meshlib.data_sharding(self.mesh, y.ndim))
+        if mask is None:
+            return xs, ys
+        ms = jax.device_put(mask, meshlib.data_sharding(self.mesh, mask.ndim))
+        return xs, ys, ms
+
+    # ---------------------------------------------------------------- step
+    def step(self, state: TrainState, x, y):
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        return self._step_fn(state, x, y)
+
+    def _build_step(self):
+        raise NotImplementedError
+
+    # ---------------------------------------------------------------- eval
+    def eval_params(self, state: TrainState) -> PyTree:
+        """Parameters to evaluate with (replicated). Subclasses with
+        per-device parameter copies override to average first."""
+        return state.params
+
+    def _build_eval(self):
+        apply_fn = self.model.apply
+        axis = self.axis
+
+        def device_eval(params, x, y, mask):
+            logits = apply_fn({"params": params}, x, train=False)
+            correct = coll.all_reduce_sum(
+                ((logits.argmax(-1) == y) * mask).sum(), axis)
+            loss_sum = coll.all_reduce_sum((cross_entropy(logits, y) * mask).sum(), axis)
+            count = coll.all_reduce_sum(mask.sum(), axis)
+            return correct, loss_sum, count
+
+        smapped = jax.shard_map(
+            device_eval, mesh=self.mesh,
+            in_specs=(P(), P(self.axis), P(self.axis), P(self.axis)),
+            out_specs=(P(), P(), P()),
+        )
+        return jax.jit(smapped)
+
+    def evaluate(self, state: TrainState, dataset, batch_size: int = 100) -> dict:
+        """Full-test-set eval — parity with the reference's server-side eval on
+        the unsharded test set (reference server.py:24-37, 179-180), not the
+        per-shard eval of dist_keras (reference dist_keras.py:53)."""
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval()
+        params = self.eval_params(state)
+        bs = max(batch_size, self.n_devices)
+        bs = (bs // self.n_devices) * self.n_devices
+        tot_correct = tot_loss = tot_count = 0.0
+        for bx, by, bm in dataset.batches(bs, shuffle=False):
+            xs, ys, ms = self.shard_batch(bx, by, bm)
+            c, l, n = self._eval_fn(params, xs, ys, ms)
+            tot_correct += float(c)
+            tot_loss += float(l)
+            tot_count += float(n)
+        return {
+            "accuracy": tot_correct / max(tot_count, 1.0),
+            "loss": tot_loss / max(tot_count, 1.0),
+            "count": int(tot_count),
+        }
+
+    # ------------------------------------------------------------- helpers
+    def _per_device_rng(self, state_rng: jax.Array, step: jax.Array) -> jax.Array:
+        rng = jax.random.fold_in(state_rng, step)
+        return jax.random.fold_in(rng, coll.axis_index(self.axis))

@@ -1,0 +1,62 @@
+"""Gossip / graph-topology data parallelism over `ppermute`.
+
+Implements for real what the reference only declares: the 'graph' and
+'custom' decentralized strategies raise NotImplementedError (reference
+initializer.py:175-181), and a `-d` node-degree flag sits commented out
+(reference initializer.py:90-92).  Each device trains locally and, every
+``mix_every`` steps, averages parameters with its ``degree`` nearest ring
+neighbors on each side — a doubly-stochastic gossip mix that provably
+preserves the parameter mean (tested in tests/test_collectives.py) and rides
+ICI neighbor links, the cheapest traffic pattern on a TPU torus.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.engines.async_local import AsyncLocalEngine
+from distributed_tensorflow_tpu.engines.base import TrainState, make_loss_fn
+from distributed_tensorflow_tpu.parallel import collectives as coll
+
+
+class GossipEngine(AsyncLocalEngine):
+    def __init__(self, *args, degree: int = 1, mix_every: int = 1, **kw):
+        kw.setdefault("sync_every", 1 << 30)  # no global sync; gossip only
+        super().__init__(*args, **kw)
+        self.degree = degree
+        self.mix_every = mix_every
+
+    def _build_step(self):
+        loss_fn = make_loss_fn(self.model.apply)
+        tx, axis = self.tx, self.axis
+        degree, mix_every = self.degree, self.mix_every
+
+        def device_step(state_1: TrainState, x, y):
+            s = jax.tree.map(lambda a: a[0], state_1)
+            rng = self._per_device_rng(s.rng, s.step)
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                s.params, x, y, rng)
+            updates, opt_state = tx.update(grads, s.opt_state, s.params)
+            params = optax.apply_updates(s.params, updates)
+            step = s.step + 1
+            do_mix = (step % mix_every) == 0
+            params = jax.lax.cond(
+                do_mix,
+                lambda p: coll.neighbor_mean(p, axis, degree),
+                lambda p: p,
+                params,
+            )
+            metrics = coll.all_reduce_mean({"loss": loss, "accuracy": acc}, axis)
+            new_s = s.replace(step=step, params=params, opt_state=opt_state)
+            return jax.tree.map(lambda a: a[None], new_s), metrics
+
+        smapped = jax.shard_map(
+            device_step, mesh=self.mesh,
+            in_specs=(P(self.axis), P(self.axis), P(self.axis)),
+            out_specs=(P(self.axis), P()),
+            check_vma=False,
+        )
+        return jax.jit(smapped, donate_argnums=0)

@@ -1,0 +1,50 @@
+"""Sync data-parallel engine.
+
+TPU-native replacement for the reference's *sync parameter server*: where the
+reference's N worker threads serially `apply_gradients` on one shared model
+under a lock and barrier (reference server.py:90-96), here each device
+computes gradients on its batch shard, `pmean` combines them over ICI, and
+every device applies one identical optimizer update — standard sync-SGD
+semantics (the deliberate semantic delta from the reference's
+sequential-apply is documented in SURVEY.md §2.4(1)).
+
+Also serves as the math core of the 'allreduce' mode (the
+MultiWorkerMirroredStrategy RING replacement, reference dist_keras.py:77-78):
+`pmean` of gradients *is* a ring allreduce on a TPU torus.
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.engines.base import Engine, TrainState, make_loss_fn
+from distributed_tensorflow_tpu.parallel import collectives as coll
+
+
+class SyncEngine(Engine):
+    def _build_step(self):
+        loss_fn = make_loss_fn(self.model.apply)
+        tx, axis = self.tx, self.axis
+
+        def device_step(state: TrainState, x, y):
+            rng = self._per_device_rng(state.rng, state.step)
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, x, y, rng)
+            # the one collective of sync DP: replaces a full TCP round-trip of
+            # pickled grads up + weights down (reference client.py:85-90)
+            grads = coll.all_reduce_mean(grads, axis)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            metrics = coll.all_reduce_mean({"loss": loss, "accuracy": acc}, axis)
+            new_state = state.replace(
+                step=state.step + 1, params=params, opt_state=opt_state)
+            return new_state, metrics
+
+        smapped = jax.shard_map(
+            device_step, mesh=self.mesh,
+            in_specs=(P(), P(self.axis), P(self.axis)),
+            out_specs=(P(), P()),
+        )
+        return jax.jit(smapped, donate_argnums=0)

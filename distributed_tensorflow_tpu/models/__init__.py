@@ -1,0 +1,66 @@
+"""L3 model plug-in point.
+
+The reference's contract is a user-editable ``model_fn() -> tf.keras.Model``
+(reference initializer.py:12-21, README.md:12).  Here ``model_fn`` returns a
+``flax.linen.Module`` whose ``__call__(x, train: bool)`` produces logits; the
+registry gives named access for the CLI, and users can still pass their own
+callable exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+
+from distributed_tensorflow_tpu.models.mlp import MLP
+from distributed_tensorflow_tpu.models.cnn import CNN
+
+_REGISTRY: dict[str, Callable[..., nn.Module]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+@register("mlp")
+@register("mnist_mlp")
+def _mlp(num_classes: int = 10, **kw) -> nn.Module:
+    """The reference's default model_fn: Flatten→Dense(512,relu)→Dropout(0.2)
+    →Dense(10) (reference initializer.py:14-19)."""
+    return MLP(num_classes=num_classes, **kw)
+
+
+@register("cnn")
+@register("mnist_cnn")
+def _cnn(num_classes: int = 10, **kw) -> nn.Module:
+    return CNN(num_classes=num_classes, **kw)
+
+
+@register("fashion_mlp")
+def _fashion_mlp(num_classes: int = 10, **kw) -> nn.Module:
+    return MLP(num_classes=num_classes, **kw)
+
+
+def create_model(name: str, num_classes: int = 10, **kw) -> nn.Module:
+    """Instantiate a registered model (lazy imports keep startup light)."""
+    if name in ("resnet20", "resnet"):
+        from distributed_tensorflow_tpu.models.resnet import ResNet20
+
+        return ResNet20(num_classes=num_classes, **kw)
+    if name in ("bert_tiny", "bert"):
+        from distributed_tensorflow_tpu.models.bert import BertTinyClassifier
+
+        return BertTinyClassifier(num_classes=num_classes, **kw)
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model '{name}'; known: {sorted(_REGISTRY)} + resnet20, bert_tiny")
+    return _REGISTRY[name](num_classes=num_classes, **kw)
+
+
+def get_model_fn(name: str, num_classes: int = 10, **kw) -> Callable[[], nn.Module]:
+    """Reference-style zero-arg model_fn (reference initializer.py:12)."""
+    return lambda: create_model(name, num_classes=num_classes, **kw)

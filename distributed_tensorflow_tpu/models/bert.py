@@ -1,0 +1,66 @@
+"""BERT-tiny sequence classifier — BASELINE.json's stretch config.
+
+The reference has no attention or sequence models anywhere (SURVEY.md §2.2:
+its only model is an MLP on 28×28, reference initializer.py:14-19);
+BASELINE.json adds "BERT-tiny GLUE fine-tune" as a stretch benchmark.
+Standard BERT-tiny shape: 2 layers, hidden 128, 2 heads, FFN 512.
+
+Input is int32 token ids (B, L); 0 is the padding id and is masked out of
+attention.  Classification head reads the [CLS] position (index 0).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class TransformerLayer(nn.Module):
+    hidden: int = 128
+    heads: int = 2
+    ffn: int = 512
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, pad_mask, train: bool = False):
+        attn_mask = nn.make_attention_mask(pad_mask, pad_mask)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, dtype=self.dtype,
+            dropout_rate=self.dropout_rate, deterministic=not train,
+        )(x, x, mask=attn_mask)
+        x = nn.LayerNorm(dtype=self.dtype)(x + y)
+        y = nn.Dense(self.ffn, dtype=self.dtype)(x)
+        y = nn.gelu(y)
+        y = nn.Dense(self.hidden, dtype=self.dtype)(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return nn.LayerNorm(dtype=self.dtype)(x + y)
+
+
+class BertTinyClassifier(nn.Module):
+    num_classes: int = 2
+    vocab_size: int = 8192
+    hidden: int = 128
+    layers: int = 2
+    heads: int = 2
+    ffn: int = 512
+    max_len: int = 512
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, token_ids, train: bool = False):
+        pad_mask = (token_ids > 0).astype(self.dtype)
+        pos = jnp.arange(token_ids.shape[1])[None, :]
+        x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype)(token_ids)
+        x = x + nn.Embed(self.max_len, self.hidden, dtype=self.dtype)(pos)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for _ in range(self.layers):
+            x = TransformerLayer(self.hidden, self.heads, self.ffn,
+                                 self.dropout_rate, self.dtype)(x, pad_mask, train)
+        cls = x[:, 0]  # [CLS] position
+        cls = nn.tanh(nn.Dense(self.hidden, dtype=self.dtype)(cls))
+        logits = nn.Dense(self.num_classes, dtype=self.dtype)(cls)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,3 @@
+"""Parallelism layer: mesh runtime (L0) and collectives (L1)."""
+
+from distributed_tensorflow_tpu.parallel import collectives, mesh  # noqa: F401

@@ -1,0 +1,118 @@
+"""L1 collectives: the framework's "wire" layer.
+
+TPU-native replacement for both of the reference's transports
+(SURVEY.md §2.3):
+
+* the hand-rolled length-prefixed pickle-over-TCP framing
+  (reference centralized/network.py:4-28), and
+* TF's `CollectiveCommunication.RING` allreduce
+  (reference decentralized/native/dist_keras.py:77-78).
+
+Tensors never touch host sockets here: every function below lowers to an XLA
+collective that rides ICI (intra-slice) or DCN (cross-slice).  All functions
+are pure and must be called inside a `jax.shard_map`-mapped function over a
+mesh axis; they are unit-tested on the 8-device CPU fake mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def axis_size(axis: str) -> int:
+    return lax.axis_size(axis)
+
+
+def axis_index(axis: str) -> jax.Array:
+    return lax.axis_index(axis)
+
+
+def all_reduce_sum(tree: PyTree, axis: str) -> PyTree:
+    """Sum across the mesh axis (RING allreduce equivalent)."""
+    return lax.psum(tree, axis_name=axis)
+
+
+def all_reduce_mean(tree: PyTree, axis: str) -> PyTree:
+    """Mean across the mesh axis — the gradient-combine step of sync DP.
+
+    Replaces one round of the reference's per-worker `('train', grads)` push /
+    weights pull over TCP (reference client.py:85-90, server.py:86-107).
+    """
+    return lax.pmean(tree, axis_name=axis)
+
+
+def all_gather(x: jax.Array, axis: str, *, tiled: bool = False) -> jax.Array:
+    return lax.all_gather(x, axis_name=axis, tiled=tiled)
+
+
+def reduce_scatter_sum(x: jax.Array, axis: str) -> jax.Array:
+    """Sum-then-shard along leading dim (`psum_scatter`)."""
+    return lax.psum_scatter(x, axis_name=axis, tiled=True)
+
+
+def all_to_all(x: jax.Array, axis: str, split_axis: int, concat_axis: int) -> jax.Array:
+    """All-to-all over the mesh axis (used by Ulysses-style sequence parallelism)."""
+    return lax.all_to_all(x, axis_name=axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ring_shift(tree: PyTree, axis: str, shift: int = 1) -> PyTree:
+    """Rotate values around the mesh-axis ring by ``shift`` positions.
+
+    Device i receives the value from device (i - shift) mod n.  This is the
+    building block for gossip averaging and ring attention.
+    """
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.tree.map(lambda x: lax.ppermute(x, axis_name=axis, perm=perm), tree)
+
+
+def neighbor_mean(tree: PyTree, axis: str, degree: int = 1) -> PyTree:
+    """Average with ``degree`` ring neighbors on each side — gossip averaging.
+
+    Implements for real the reference's declared-but-unimplemented
+    `graph`/`custom` decentralized strategies (reference initializer.py:175-181
+    raise NotImplementedError; the vestigial `-d` degree flag is reference
+    initializer.py:90-92).  Each device's value becomes the mean of itself and
+    its `2*degree` nearest ring neighbors.
+    """
+    if degree <= 0:
+        return tree
+    n = lax.axis_size(axis)
+    if 2 * degree + 1 >= n:
+        # neighborhood covers the whole ring — full averaging (also handles
+        # tiny meshes like n=2 where fwd/bwd neighbors coincide and naive
+        # clamping would silently disable mixing)
+        return lax.pmean(tree, axis_name=axis)
+
+    def mix(x):
+        acc = x
+        for d in range(1, degree + 1):
+            fwd = [(i, (i + d) % n) for i in range(n)]
+            bwd = [(i, (i - d) % n) for i in range(n)]
+            acc = acc + lax.ppermute(x, axis_name=axis, perm=fwd)
+            acc = acc + lax.ppermute(x, axis_name=axis, perm=bwd)
+        return acc / (2 * degree + 1)
+
+    return jax.tree.map(mix, tree)
+
+
+def broadcast_from(tree: PyTree, axis: str, src: int = 0) -> PyTree:
+    """Broadcast device ``src``'s value to every device on the axis.
+
+    Replaces the reference's initial-weights broadcast on the 'start'
+    message (reference server.py:70-84, client.py:67-72).
+    """
+    idx = lax.axis_index(axis)
+
+    def sel(x):
+        mask = (idx == src).astype(x.dtype)
+        return lax.psum(x * mask, axis_name=axis)
+
+    return jax.tree.map(sel, tree)

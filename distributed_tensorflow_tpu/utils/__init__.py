@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: harness, supervisor reporting, wire framing."""

@@ -1,0 +1,175 @@
+"""L5 experiment harness: configure → train → time → evaluate → report.
+
+Reproduces the reference's measurement window semantics: the clock runs from
+"all workers ready" to "all workers finished" (start/end barriers, reference
+server.py:76-79, 115-119) — here from just before the first training step to
+`block_until_ready` after the last — and final accuracy is evaluated on the
+full unsharded test set (reference server.py:179-180).  Compile time is
+reported separately (`compile_s`): XLA traces/compiles on the first step,
+which the wall-clock window includes, exactly as TF's first-batch graph
+build was included in the reference's window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import jax
+
+from distributed_tensorflow_tpu import models as modellib
+from distributed_tensorflow_tpu.data import loaders
+from distributed_tensorflow_tpu.engines import create_engine
+from distributed_tensorflow_tpu.engines.allreduce import Trainer
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+from distributed_tensorflow_tpu.utils.supervisor import ResultSink
+
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    """Everything the reference CLI configures (reference initializer.py:72-114),
+    plus the TPU-native knobs."""
+
+    engine: str = "sync"            # sync | async | allreduce | gossip
+    model: str = "mlp"
+    dataset: str = "mnist"
+    n_devices: int | None = None    # the reference's -n, as TPU device count
+    batch_size: int = 32            # global batch (reference -b is per-worker;
+                                    # global = b × n, see run() docstring)
+    per_worker_batch: bool = True   # interpret batch_size per device like -b
+    epochs: int = 1                 # reference fixes 1 (SURVEY.md §2.4(6))
+    learning_rate: float = 1e-3
+    sync_every: int = 10            # async engine's averaging period
+    degree: int = 1                 # gossip neighbor degree (the -d flag)
+    seed: int = 0
+    eval_batch: int = 100           # reference's test batch (server.py:179)
+    log_every: int = 50
+    result_path: str | None = None
+    supervisor_address: str | None = None  # reference's -sa / port-4000 channel
+    model_fn: Callable | None = None       # user plug-in override (README.md:12)
+    dataset_fn: Callable | None = None
+    target_accuracy: float | None = None   # e.g. 0.97 for steps-to-97%
+
+
+@dataclasses.dataclass
+class _Experiment:
+    """Resolved experiment: mesh, data, model, engine, global batch."""
+
+    mesh: Any
+    n: int
+    train_ds: Any
+    test_ds: Any
+    engine: Any
+    global_batch: int
+
+
+def _setup(config: ExperimentConfig) -> _Experiment:
+    mesh = meshlib.create_mesh(config.n_devices)
+    n = mesh.shape[meshlib.DATA_AXIS]
+
+    if config.dataset_fn is not None:
+        train_ds = config.dataset_fn(config.batch_size, type="train")
+        test_ds = config.dataset_fn(config.eval_batch, type="test")
+    else:
+        train_ds = loaders.load_dataset(config.dataset, split="train")
+        test_ds = loaders.load_dataset(config.dataset, split="test")
+
+    if config.model_fn is not None:
+        model = config.model_fn()
+    else:
+        model = modellib.create_model(config.model, num_classes=train_ds.num_classes)
+
+    # reference -b is the PER-WORKER batch (reference client.py:64 feeds each
+    # worker's shard with batch_size b); global batch = b × n matches its
+    # aggregate examples-per-round
+    global_batch = config.batch_size * n if config.per_worker_batch else config.batch_size
+    global_batch = max(global_batch, n)
+
+    engine_kw: dict[str, Any] = dict(mesh=mesh, learning_rate=config.learning_rate)
+    if config.engine == "async":
+        engine_kw["sync_every"] = config.sync_every
+    elif config.engine == "gossip":
+        engine_kw["degree"] = config.degree
+    engine = create_engine(config.engine, model, **engine_kw)
+    return _Experiment(mesh=mesh, n=n, train_ds=train_ds, test_ds=test_ds,
+                       engine=engine, global_batch=global_batch)
+
+
+def run(config: ExperimentConfig) -> dict[str, Any]:
+    """Run one experiment; returns the summary dict (also emitted as JSONL)."""
+    ex = _setup(config)
+    n, train_ds, test_ds = ex.n, ex.train_ds, ex.test_ds
+    global_batch = ex.global_batch
+
+    sink = ResultSink(config.result_path, echo=False,
+                      supervisor_address=config.supervisor_address)
+    trainer = Trainer(None, engine=ex.engine, seed=config.seed)
+
+    sink.start()
+    fit = trainer.fit(train_ds, epochs=config.epochs, batch_size=global_batch,
+                      log_every=config.log_every)
+    sink.done(fit["elapsed"])
+    ev = trainer.evaluate(test_ds, batch_size=config.eval_batch)
+    sink.results(ev["accuracy"], loss=ev["loss"])
+
+    summary = {
+        "engine": config.engine,
+        "model": config.model,
+        "dataset": train_ds.name,
+        "synthetic_data": train_ds.synthetic,
+        "n_devices": n,
+        "global_batch": global_batch,
+        "epochs": config.epochs,
+        "steps": fit["steps"],
+        "elapsed_s": fit["elapsed"],
+        "examples_per_sec": fit["examples_per_sec"],
+        "examples_per_sec_per_device": fit["examples_per_sec"] / n,
+        "test_accuracy": ev["accuracy"],
+        "test_loss": ev["loss"],
+    }
+    sink.emit("summary", **summary)
+    sink.close()
+    return summary
+
+
+def steps_to_accuracy(
+    config: ExperimentConfig,
+    target: float,
+    max_steps: int = 10_000,
+    eval_every: int = 50,
+) -> dict[str, Any]:
+    """Steps-to-target measurement (BASELINE.md north star: steps-to-97%).
+
+    Counts *global* batches, the normalization BASELINE.md requires when
+    comparing against the reference's sequential-apply sync PS
+    (SURVEY.md §2.4(1)).  Evaluates every ``eval_every`` steps, so the
+    returned step count is accurate to that resolution.
+    """
+    ex = _setup(config)
+    eng = ex.engine
+    rng = jax.random.key(config.seed)
+    state = eng.init_state(rng, ex.train_ds.x[: max(1, ex.n)])
+
+    steps = 0
+    epoch = 0
+    acc = 0.0
+    t0 = time.perf_counter()
+    while steps < max_steps:
+        for bx, by, _ in ex.train_ds.batches(
+                ex.global_batch, shuffle=True, seed=config.seed, epoch=epoch,
+                drop_remainder=True):
+            xs, ys = eng.shard_batch(bx, by)
+            state, _ = eng.step(state, xs, ys)
+            steps += 1
+            if steps % eval_every == 0 or steps >= max_steps:
+                acc = eng.evaluate(state, ex.test_ds,
+                                   batch_size=config.eval_batch)["accuracy"]
+                if acc >= target:
+                    return {"reached": True, "steps": steps, "accuracy": acc,
+                            "elapsed_s": time.perf_counter() - t0}
+                if steps >= max_steps:
+                    break
+        epoch += 1
+    return {"reached": False, "steps": steps, "accuracy": acc,
+            "elapsed_s": time.perf_counter() - t0}

@@ -1,0 +1,64 @@
+"""Length-prefixed socket framing — control-plane parity with the reference.
+
+The reference frames every message as a 4-byte big-endian length plus a
+pickled payload (reference centralized/network.py:4-28) and uses it both for
+the gradient/weight wire and the out-of-band supervisor channel.  In this
+framework tensors NEVER travel over sockets (XLA collectives own the data
+plane); this module exists only for the supervisor/benchmark-harness channel
+(reference server.py:121-124, 182-187; dist_keras.py:34-58) and for any
+external tool speaking the reference's protocol.
+
+Payloads are JSON by default.  Pickle decode of *incoming* data is opt-in
+(``allow_pickle=True``) because unpickling untrusted bytes executes code;
+pickle *encode* is provided for compatibility with reference-style listeners.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import socket
+import struct
+from typing import Any
+
+_LEN = struct.Struct(">I")  # 4-byte big-endian length, reference network.py:6
+
+
+def send_bytes(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_bytes(sock: socket.socket) -> bytes | None:
+    header = recvall(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    return recvall(sock, n)
+
+
+def recvall(sock: socket.socket, n: int) -> bytes | None:
+    """Blocking read of exactly n bytes (reference network.py:20-28)."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_msg(sock: socket.socket, obj: Any, *, use_pickle: bool = False) -> None:
+    data = pickle.dumps(obj, -1) if use_pickle else json.dumps(obj).encode()
+    send_bytes(sock, data)
+
+
+def recv_msg(sock: socket.socket, *, allow_pickle: bool = False) -> Any | None:
+    data = recv_bytes(sock)
+    if data is None:
+        return None
+    if allow_pickle:
+        try:
+            return json.loads(data)
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            return pickle.loads(data)
+    return json.loads(data)

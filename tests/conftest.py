@@ -1,0 +1,34 @@
+"""Test harness: fake 8-device CPU mesh.
+
+The SPMD analogue of the reference's fake cluster (fork + loopback TCP,
+reference initializer.py:134-145): we expose 8 XLA host-platform devices so
+every multi-device code path runs on CPU.  The environment may preload jax
+(sitecustomize) before this module runs, so we switch platform via
+``jax.config`` — valid as long as no backend has been initialized yet.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+    return meshlib.create_mesh(8)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

@@ -1,0 +1,68 @@
+"""Model registry + forward-shape tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models import create_model, get_model_fn
+
+
+@pytest.mark.parametrize("name,shape", [
+    ("mlp", (2, 28, 28, 1)),
+    ("cnn", (2, 28, 28, 1)),
+    ("cnn", (2, 28, 28)),   # no-channel input path
+])
+def test_forward_shapes(name, shape):
+    model = create_model(name, num_classes=10)
+    x = jnp.ones(shape)
+    params = model.init(jax.random.key(0), x, train=False)["params"]
+    logits = model.apply({"params": params}, x, train=False)
+    assert logits.shape == (shape[0], 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_dropout_train_vs_eval():
+    model = create_model("mlp")
+    x = jnp.ones((4, 28, 28, 1))
+    params = model.init(jax.random.key(0), x, train=False)["params"]
+    e1 = model.apply({"params": params}, x, train=False)
+    e2 = model.apply({"params": params}, x, train=False)
+    np.testing.assert_array_equal(e1, e2)  # eval is deterministic
+    t1 = model.apply({"params": params}, x, train=True,
+                     rngs={"dropout": jax.random.key(1)})
+    t2 = model.apply({"params": params}, x, train=True,
+                     rngs={"dropout": jax.random.key(2)})
+    assert not np.array_equal(t1, t2)  # dropout active in train
+
+
+def test_model_fn_contract():
+    # reference-style zero-arg model_fn (reference initializer.py:12)
+    fn = get_model_fn("mlp", num_classes=7)
+    m = fn()
+    assert m.num_classes == 7
+
+
+def test_unknown_model():
+    with pytest.raises(KeyError):
+        create_model("transformer_xxl")
+
+
+def test_resnet20_forward():
+    model = create_model("resnet20", num_classes=10)
+    x = jnp.ones((2, 32, 32, 3))
+    params = model.init(jax.random.key(0), x, train=False)["params"]
+    logits = model.apply({"params": params}, x, train=False)
+    assert logits.shape == (2, 10)
+
+
+def test_bert_tiny_forward():
+    model = create_model("bert_tiny", num_classes=2, vocab_size=100, max_len=32)
+    ids = jnp.array(np.random.default_rng(0).integers(1, 100, (2, 16)))
+    params = model.init(jax.random.key(0), ids, train=False)["params"]
+    logits = model.apply({"params": params}, ids, train=False)
+    assert logits.shape == (2, 2)
+    # padding must not change unpadded positions' logits meaningfully
+    padded = jnp.concatenate([ids, jnp.zeros((2, 4), jnp.int32)], axis=1)
+    lp = model.apply({"params": params}, padded, train=False)
+    np.testing.assert_allclose(logits, lp, atol=1e-4)

@@ -1,0 +1,44 @@
+"""Supervisor channel + wire framing tests (reference network.py / port-4000
+protocol parity, SURVEY.md §2.3)."""
+
+import socket
+import threading
+
+from distributed_tensorflow_tpu.utils import wire
+from distributed_tensorflow_tpu.utils.supervisor import ResultSink, SupervisorListener
+
+
+def test_wire_roundtrip():
+    a, b = socket.socketpair()
+    wire.send_msg(a, {"k": [1, 2, 3]})
+    assert wire.recv_msg(b) == {"k": [1, 2, 3]}
+    # length-prefix framing survives split delivery
+    wire.send_msg(a, "x" * 70000)
+    assert wire.recv_msg(b) == "x" * 70000
+    a.close()
+    assert wire.recv_msg(b) is None  # closed → None (reference network.py:12-13)
+    b.close()
+
+
+def test_wire_pickle_compat():
+    # reference-style pickle payloads decode when explicitly allowed
+    a, b = socket.socketpair()
+    wire.send_msg(a, ["done", 1.5], use_pickle=True)
+    assert wire.recv_msg(b, allow_pickle=True) == ["done", 1.5]
+    a.close(); b.close()
+
+
+def test_result_sink_event_triple(tmp_path):
+    # the reference's exact supervisor sequence: start, done(elapsed),
+    # results(accuracy) — server.py:121-124, 182-187
+    listener = SupervisorListener()
+    sink = ResultSink(tmp_path / "r.jsonl", supervisor_address="127.0.0.1",
+                      supervisor_port=listener.port)
+    sink.start()
+    sink.done(12.5)
+    sink.results(0.97)
+    sink.close()
+    listener._thread.join(timeout=2)
+    assert listener.messages == ["start", ["done", 12.5], ["results", 0.97]]
+    assert [e["event"] for e in sink.events] == ["start", "done", "results"]
+    listener.close()
