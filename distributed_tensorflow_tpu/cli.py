@@ -80,6 +80,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "-d flag, initializer.py:90-92)")
     p.add_argument("--num-processes", type=int, default=None,
                    help="multi-host: total process count")
+    p.add_argument("-sp", "--seq-parallel", type=int, default=1,
+                   help="shard sequences over this many devices (long-context "
+                        "mode; requires a sequence model, e.g. --model bert_tiny)")
+    p.add_argument("--attention", default="ring", choices=["ring", "ulysses"],
+                   help="sequence-parallel attention strategy")
     p.add_argument("--result-path", default=None, help="JSONL event sink path")
     p.add_argument("--log-every", type=int, default=50)
     p.add_argument("--seed", type=int, default=0)
@@ -129,6 +134,8 @@ def main(argv: list[str] | None = None) -> dict:
         log_every=args.log_every,
         result_path=args.result_path,
         supervisor_address=None,
+        seq_parallel=args.seq_parallel,
+        attention_impl=args.attention,
     )
     summary = run(config)
     print(json.dumps(summary))

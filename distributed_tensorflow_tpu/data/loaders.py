@@ -131,6 +131,52 @@ def synthetic_classification(
     return x.clip(0.0, 1.0).astype(np.float32), y
 
 
+def synthetic_text_classification(
+    n: int,
+    seq_len: int = 128,
+    vocab_size: int = 1024,
+    num_classes: int = 2,
+    seed: int = 0,
+    split: str = "train",
+):
+    """Topic-model synthetic text: each class draws tokens from its own
+    Zipf-reweighted vocabulary distribution (BERT-tiny learns it quickly —
+    the GLUE-stand-in for the zero-egress environment).  Token id 0 is
+    reserved for padding; sequences are full-length."""
+    proto_rng = np.random.default_rng(seed)
+    base = 1.0 / np.arange(1, vocab_size)  # ids 1..V-1, Zipf-ish
+    class_logits = np.stack([
+        np.log(base) + 0.75 * proto_rng.normal(size=vocab_size - 1)
+        for _ in range(num_classes)
+    ])
+    probs = np.exp(class_logits)
+    probs /= probs.sum(axis=1, keepdims=True)
+    rng = np.random.default_rng((seed, 0 if split == "train" else 1))
+    y = rng.integers(0, num_classes, size=n).astype(np.int32)
+    x = np.stack([
+        rng.choice(vocab_size - 1, size=seq_len, p=probs[c]) + 1 for c in y
+    ]).astype(np.int32)
+    x[:, 0] = 1  # fixed [CLS]-like token at position 0
+    return x, y
+
+
+def load_text_dataset(
+    name: str = "glue_synth",
+    split: str = "train",
+    seq_len: int = 128,
+    vocab_size: int = 1024,
+    n_train: int = 4096,
+    n_test: int = 1024,
+) -> Dataset:
+    """Text workload loader (BASELINE.json BERT-tiny stretch config).
+    Currently synthetic-only: real GLUE needs downloads this env can't do."""
+    n = n_train if split == "train" else n_test
+    x, y = synthetic_text_classification(
+        n, seq_len=seq_len, vocab_size=vocab_size,
+        seed=sum(ord(c) for c in name) % (2**31), split=split)
+    return Dataset(x=x, y=y, num_classes=2, name=name, synthetic=True)
+
+
 def load_dataset(
     name: str,
     split: str = "train",
@@ -143,6 +189,8 @@ def load_dataset(
     ``reshape`` mirrors the reference's flag (reference initializer.py:28-35):
     True adds a trailing channel dim to 2-D images ((28,28) → (28,28,1)).
     """
+    if name in ("glue_synth", "text", "glue"):
+        return load_text_dataset(name, split=split)
     if name in ("synthetic", "synth"):
         name, shape, ncls, path = "synthetic", (28, 28), 10, None
     elif name in _SHAPES:

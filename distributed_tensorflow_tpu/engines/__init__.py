@@ -22,6 +22,7 @@ from distributed_tensorflow_tpu.engines.sync import SyncEngine  # noqa: F401
 from distributed_tensorflow_tpu.engines.async_local import AsyncLocalEngine  # noqa: F401
 from distributed_tensorflow_tpu.engines.gossip import GossipEngine  # noqa: F401
 from distributed_tensorflow_tpu.engines.allreduce import Trainer  # noqa: F401
+from distributed_tensorflow_tpu.engines.seq_parallel import SeqParallelEngine  # noqa: F401
 
 ENGINES = {
     "sync": SyncEngine,

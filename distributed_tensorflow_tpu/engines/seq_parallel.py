@@ -1,0 +1,155 @@
+"""Sequence-parallel (context-parallel) training engine over a 2-D mesh.
+
+New TPU-native capability with no reference counterpart (the reference's
+models have no sequence axis, SURVEY.md §2.2 — this is the "long-context is
+first-class" requirement): token sequences are sharded over a ``seq`` mesh
+axis *in addition to* batch sharding over ``data``, so sequences longer than
+one device's memory train with ring or Ulysses attention
+(parallel/ring_attention.py).
+
+Gradient bookkeeping: parameters are replicated everywhere.  The model runs
+inside shard_map with tokens sharded (B/'data', L/'seq').  Every seq device
+computes the same logits (the [CLS] readout is broadcast from seq-device 0,
+models/bert.py), so the per-device loss is scaled by 1/seq_n; gradients are
+then `psum` over 'seq' (each seq device holds a *partial* grad through its
+token block) and `pmean` over 'data' (each data shard holds the mean over
+its examples).  The broadcast/ppermute transposes deliver exactly the right
+cross-device cotangents — verified against single-device dense training in
+tests/test_seq_parallel.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.engines.base import (
+    Engine, TrainState, cross_entropy)
+from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+
+class SeqParallelEngine(Engine):
+    """Data×sequence parallel sync training.
+
+    ``mesh`` must have axes ('data', 'seq'); the model's ``attention_impl``
+    must be 'ring' or 'ulysses' with ``seq_axis='seq'``.
+    """
+
+    seq_axis = meshlib.SEQ_AXIS
+
+    def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3):
+        if mesh is None:
+            raise ValueError("SeqParallelEngine requires an explicit "
+                             "('data','seq') mesh")
+        if set(mesh.axis_names) != {meshlib.DATA_AXIS, meshlib.SEQ_AXIS}:
+            raise ValueError(f"mesh axes must be (data, seq), got {mesh.axis_names}")
+        if getattr(model, "attention_impl", None) not in ("ring", "ulysses"):
+            raise ValueError(
+                "SeqParallelEngine needs a model with attention_impl 'ring' or "
+                "'ulysses' — dense attention on sequence-sharded activations "
+                "would silently attend within local blocks only")
+        super().__init__(model, optimizer, mesh, learning_rate)
+        self.seq_n = mesh.shape[self.seq_axis]
+
+    # Params are initialized OUTSIDE shard_map: the ring/broadcast collectives
+    # can't trace there, so init uses a dense-attention twin (identical param
+    # structure — only the attention *algorithm* differs), on a local-length
+    # sequence slice (param shapes don't depend on seq length).
+    def init_state(self, rng, sample_x) -> TrainState:
+        lq = sample_x.shape[1] // self.seq_n
+        twin = self.model
+        if getattr(twin, "attention_impl", "dense") != "dense":
+            twin = twin.clone(attention_impl="dense")
+        params = twin.init(rng, jnp.asarray(sample_x[:1, :lq]),
+                           train=False)["params"]
+        opt_state = self.tx.init(params)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           opt_state=opt_state, rng=rng)
+        return jax.device_put(state, meshlib.replicated(self.mesh))
+
+    def shard_batch(self, x, y, mask=None):
+        xs = jax.device_put(x, NamedSharding(
+            self.mesh, P(meshlib.DATA_AXIS, meshlib.SEQ_AXIS)))
+        ys = jax.device_put(y, NamedSharding(self.mesh, P(meshlib.DATA_AXIS)))
+        if mask is None:
+            return xs, ys
+        ms = jax.device_put(mask, NamedSharding(self.mesh, P(meshlib.DATA_AXIS)))
+        return xs, ys, ms
+
+    def _build_step(self):
+        apply_fn = self.model.apply
+        tx = self.tx
+        data_axis, seq_axis = self.axis, self.seq_axis
+
+        def device_step(state: TrainState, x, y):
+            rng = jax.random.fold_in(state.rng, state.step)
+            rng = jax.random.fold_in(rng, coll.axis_index(data_axis))
+            # fold over seq too: every dropout op in the model acts on
+            # seq-sharded activations (token blocks), so per-seq-device masks
+            # must be independent — a shared mask would drop the same local
+            # offsets in every block (structured, weaker regularization)
+            rng = jax.random.fold_in(rng, coll.axis_index(seq_axis))
+            dp = lax.axis_size(data_axis)
+
+            def scaled_loss(params):
+                logits = apply_fn({"params": params}, x, train=True,
+                                  rngs={"dropout": rng})
+                loss = cross_entropy(logits, y).mean()
+                acc = (logits.argmax(-1) == y).mean()
+                # The loss is varying over 'data' (per-shard batches) and
+                # INVARIANT over 'seq' (logits come from the [CLS] broadcast,
+                # identical on every seq device).  shard_map's AD transpose
+                # psums param-cotangents over BOTH axes at the
+                # varying→invariant boundaries (incl. through the ring's
+                # ppermutes), so with the 1/dp scaling the returned grads are
+                # exactly the global-batch mean gradient — no explicit grad
+                # collectives (verified against single-device dense training
+                # in tests/test_seq_parallel.py, with SGD so scaling can't
+                # hide behind Adam's scale invariance).
+                return loss / dp, (loss, acc)
+
+            (_, (loss, acc)), grads = jax.value_and_grad(
+                scaled_loss, has_aux=True)(state.params)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            metrics = {
+                "loss": lax.pmean(loss, data_axis),
+                "accuracy": lax.pmean(acc, data_axis),
+            }
+            new_state = state.replace(
+                step=state.step + 1, params=params, opt_state=opt_state)
+            return new_state, metrics
+
+        smapped = jax.shard_map(
+            device_step, mesh=self.mesh,
+            in_specs=(P(), P(data_axis, seq_axis), P(data_axis)),
+            out_specs=(P(), P()),
+        )
+        return jax.jit(smapped, donate_argnums=0)
+
+    def _build_eval(self):
+        apply_fn = self.model.apply
+        data_axis, seq_axis = self.axis, self.seq_axis
+
+        def device_eval(params, x, y, mask):
+            logits = apply_fn({"params": params}, x, train=False)
+            correct = ((logits.argmax(-1) == y) * mask).sum()
+            loss_sum = (cross_entropy(logits, y) * mask).sum()
+            count = mask.sum()
+            # logits identical across seq (invariant): only the data axis
+            # needs reducing
+            out = lax.psum(jnp.stack([correct, loss_sum, count]), data_axis)
+            return out[0], out[1], out[2]
+
+        smapped = jax.shard_map(
+            device_eval, mesh=self.mesh,
+            in_specs=(P(), P(data_axis, seq_axis), P(data_axis), P(data_axis)),
+            out_specs=(P(), P(), P()),
+        )
+        return jax.jit(smapped)
+        # Engine.evaluate is inherited: self.n_devices is the data-axis size,
+        # and shard_batch/_build_eval above handle the 2-D placement.

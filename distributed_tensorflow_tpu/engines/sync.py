@@ -30,11 +30,24 @@ class SyncEngine(Engine):
 
         def device_step(state: TrainState, x, y):
             rng = self._per_device_rng(state.rng, state.step)
-            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params, x, y, rng)
-            # the one collective of sync DP: replaces a full TCP round-trip of
-            # pickled grads up + weights down (reference client.py:85-90)
-            grads = coll.all_reduce_mean(grads, axis)
+            n = jax.lax.axis_size(axis)
+
+            def scaled_loss(params):
+                loss, acc = loss_fn(params, x, y, rng)
+                # scale so the cross-device SUM of per-device losses is the
+                # global batch mean: under shard_map's varying-axes typing,
+                # grad-of-replicated-params IS psum'd over the data axis by
+                # the AD transpose (the varying→invariant boundary).  That
+                # implicit psum is the allreduce of sync DP — the XLA
+                # equivalent of the reference's per-batch TCP round-trip of
+                # pickled grads up + weights down (reference client.py:85-90).
+                # An explicit pmean here would silently no-op (invariant
+                # input), wrecking the scale — tested against single-device
+                # training with SGD in tests/test_engines.py.
+                return loss / n, (loss, acc)
+
+            (_, (loss, acc)), grads = jax.value_and_grad(
+                scaled_loss, has_aux=True)(state.params)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             metrics = coll.all_reduce_mean({"loss": loss, "accuracy": acc}, axis)

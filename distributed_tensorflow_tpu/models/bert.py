@@ -5,15 +5,58 @@ its only model is an MLP on 28×28, reference initializer.py:14-19);
 BASELINE.json adds "BERT-tiny GLUE fine-tune" as a stretch benchmark.
 Standard BERT-tiny shape: 2 layers, hidden 128, 2 heads, FFN 512.
 
-Input is int32 token ids (B, L); 0 is the padding id and is masked out of
-attention.  Classification head reads the [CLS] position (index 0).
+Attention is pluggable (``attention_impl``):
+  'dense'   — ordinary full attention; any mesh, no seq sharding.
+  'ring'    — ring attention over the ``seq`` mesh axis; the model must run
+              inside `jax.shard_map` with the token dim sharded over 'seq'
+              (see engines.seq_parallel).  K/V blocks rotate via ppermute.
+  'ulysses' — all-to-all head-parallel attention over 'seq'; same contract,
+              plus num_heads % seq_axis_size == 0.
+
+Input is int32 token ids (B, L_local); 0 is the padding id and is masked out
+of attention.  The classification head reads the [CLS] position (global
+index 0); under sequence parallelism only seq-device 0 holds it, so the head
+uses a broadcast from that device.
 """
 
 from __future__ import annotations
 
 import flax.linen as nn
 import jax.numpy as jnp
-import numpy as np
+
+from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel.ring_attention import (
+    dense_attention, ring_attention, ulysses_attention)
+
+
+class SelfAttention(nn.Module):
+    hidden: int = 128
+    heads: int = 2
+    attention_impl: str = "dense"
+    seq_axis: str = "seq"
+    dropout_rate: float = 0.0   # attention-probability dropout (dense only:
+                                # blockwise ring/ulysses skip it, as flash-
+                                # style attention implementations do)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, pad_mask, train: bool = False):
+        head_dim = self.hidden // self.heads
+        proj = lambda name: nn.DenseGeneral(  # noqa: E731
+            features=(self.heads, head_dim), dtype=self.dtype, name=name)
+        q, k, v = proj("query")(x), proj("key")(x), proj("value")(x)
+        if self.attention_impl == "ring":
+            out = ring_attention(q, k, v, axis=self.seq_axis, kv_mask=pad_mask)
+        elif self.attention_impl == "ulysses":
+            out = ulysses_attention(q, k, v, axis=self.seq_axis, kv_mask=pad_mask)
+        else:
+            prob_fn = None
+            if self.dropout_rate > 0.0:
+                drop = nn.Dropout(self.dropout_rate, deterministic=not train)
+                prob_fn = lambda p: drop(p)  # noqa: E731
+            out = dense_attention(q, k, v, kv_mask=pad_mask, prob_fn=prob_fn)
+        return nn.DenseGeneral(features=self.hidden, axis=(-2, -1),
+                               dtype=self.dtype, name="out")(out)
 
 
 class TransformerLayer(nn.Module):
@@ -21,15 +64,15 @@ class TransformerLayer(nn.Module):
     heads: int = 2
     ffn: int = 512
     dropout_rate: float = 0.1
+    attention_impl: str = "dense"
+    seq_axis: str = "seq"
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, pad_mask, train: bool = False):
-        attn_mask = nn.make_attention_mask(pad_mask, pad_mask)
-        y = nn.MultiHeadDotProductAttention(
-            num_heads=self.heads, dtype=self.dtype,
-            dropout_rate=self.dropout_rate, deterministic=not train,
-        )(x, x, mask=attn_mask)
+        y = SelfAttention(self.hidden, self.heads, self.attention_impl,
+                          self.seq_axis, self.dropout_rate,
+                          self.dtype)(x, pad_mask, train)
         x = nn.LayerNorm(dtype=self.dtype)(x + y)
         y = nn.Dense(self.ffn, dtype=self.dtype)(x)
         y = nn.gelu(y)
@@ -47,20 +90,40 @@ class BertTinyClassifier(nn.Module):
     ffn: int = 512
     max_len: int = 512
     dropout_rate: float = 0.1
+    attention_impl: str = "dense"
+    seq_axis: str = "seq"
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, token_ids, train: bool = False):
+        seq_parallel = self.attention_impl in ("ring", "ulysses")
         pad_mask = (token_ids > 0).astype(self.dtype)
-        pos = jnp.arange(token_ids.shape[1])[None, :]
+        lq = token_ids.shape[1]
+        # nn.Embed clamps out-of-range gathers silently — fail loudly instead
+        global_len = lq * (coll.axis_size(self.seq_axis) if seq_parallel else 1)
+        if global_len > self.max_len:
+            raise ValueError(
+                f"sequence length {global_len} exceeds max_len={self.max_len}; "
+                f"raise max_len or shorten the input")
+        if seq_parallel:
+            # local block's global positions: block index × local length
+            offset = coll.axis_index(self.seq_axis) * lq
+            pos = offset + jnp.arange(lq)[None, :]
+        else:
+            pos = jnp.arange(lq)[None, :]
         x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype)(token_ids)
         x = x + nn.Embed(self.max_len, self.hidden, dtype=self.dtype)(pos)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         for _ in range(self.layers):
             x = TransformerLayer(self.hidden, self.heads, self.ffn,
-                                 self.dropout_rate, self.dtype)(x, pad_mask, train)
-        cls = x[:, 0]  # [CLS] position
+                                 self.dropout_rate, self.attention_impl,
+                                 self.seq_axis, self.dtype)(x, pad_mask, train)
+        cls = x[:, 0]  # [CLS]: global position 0
+        if seq_parallel:
+            # only seq-device 0 holds the real [CLS]; replicate it so the
+            # head computes identically on every seq device
+            cls = coll.broadcast_from(cls, self.seq_axis, src=0)
         cls = nn.tanh(nn.Dense(self.hidden, dtype=self.dtype)(cls))
         logits = nn.Dense(self.num_classes, dtype=self.dtype)(cls)
         return logits.astype(jnp.float32)

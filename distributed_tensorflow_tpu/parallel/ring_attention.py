@@ -1,0 +1,155 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no attention and no sequence axis anywhere (SURVEY.md §2.2:
+its only model is an MLP on 28×28, reference initializer.py:14-19) — this
+module is TPU-native *new* capability required for long-context training:
+sequences longer than one device's memory are sharded over a ``seq`` mesh
+axis and attention runs without ever materializing the full (L, L) score
+matrix on one chip.
+
+Two standard strategies, both built on the L1 collectives layer:
+
+* **Ring attention** (`ring_attention`): K/V blocks rotate around the mesh
+  ring via `ppermute` while each device's Q stays put; partial softmax
+  results merge with the numerically-stable running log-sum-exp (the
+  blockwise/flash accumulation).  Communication is nearest-neighbor only —
+  the cheapest pattern on a TPU torus (ICI), overlapping compute with the
+  next block's transfer.
+* **Ulysses** (`ulysses_attention`): `all_to_all` reshards activations from
+  sequence-sharded to head-sharded, runs ordinary dense attention on full
+  sequences for a subset of heads, and reshards back.  Needs
+  ``num_heads % axis_size == 0``.
+
+All functions must be called inside `jax.shard_map` with the sequence dim
+sharded over ``axis``.  Shapes: q/k/v are (batch, seq_local, heads, head_dim).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_tensorflow_tpu.parallel.collectives import ring_shift
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() NaN-free when
+                 # an entire block is masked (first causal blocks)
+
+
+def _block_scores(q, k, scale):
+    # (B, Lq, H, D) x (B, Lk, H, D) -> (B, H, Lq, Lk)
+    return jnp.einsum("blhd,bmhd->bhlm", q, k) * scale
+
+
+def dense_attention(q, k, v, causal: bool = False, scale: float | None = None,
+                    kv_mask=None, prob_fn=None):
+    """Single-device reference attention (test oracle and small-seq path).
+
+    ``kv_mask``: optional (B, Lk) key-validity mask; masked keys get NEG_INF.
+    ``prob_fn``: optional transform of the post-softmax probabilities —
+    the hook for attention-probability dropout (blockwise ring attention
+    cannot support it; flash-style implementations conventionally drop it).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = _block_scores(q, k, scale)
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        qpos = jnp.arange(lq)[:, None]
+        kpos = jnp.arange(lk)[None, :]
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :] > 0, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if prob_fn is not None:
+        p = prob_fn(p)
+    return jnp.einsum("bhlm,bmhd->blhd", p, v)
+
+
+def ring_attention(q, k, v, axis: str, causal: bool = False,
+                   scale: float | None = None, kv_mask=None):
+    """Blockwise ring attention over the ``axis`` mesh ring.
+
+    Device i holds Q/K/V for sequence block i.  At ring step t it attends
+    Q_i against the K/V block that originated at device (i - t) mod n, then
+    passes its current K/V to device i+1.  After n steps every Q block has
+    seen every K/V block; the running (max, sum, acc) merge makes the result
+    exactly softmax(QKᵀ)V, independent of arrival order.
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+
+    # derive from k so the mask inherits k's varying-axes type (the fori_loop
+    # carry requires input/output types — incl. vma — to match exactly)
+    mask0 = kv_mask if kv_mask is not None else jnp.ones_like(k[..., 0, 0])
+
+    def process(t, m, l, acc, k_cur, v_cur, mk_cur):
+        src = (idx - t) % n  # which global block k_cur/v_cur came from
+        s = _block_scores(q, k_cur, scale)  # (B,H,Lq,Lk)
+        if causal:
+            qpos = idx * lq + jnp.arange(lq)
+            kpos = src * lk + jnp.arange(lk)
+            s = jnp.where(qpos[:, None] >= kpos[None, :], s, NEG_INF)
+        s = jnp.where(mk_cur[:, None, None, :] > 0, s, NEG_INF)
+        m_blk = s.max(axis=-1)                     # (B,H,Lq)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new[..., None])          # (B,H,Lq,Lk)
+        corr = jnp.exp(m - m_new)                  # (B,H,Lq)
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhlm,bmhd->blhd", p, v_cur)
+        acc_new = acc * corr.transpose(0, 2, 1)[..., None] + pv
+        return m_new, l_new, acc_new
+
+    def body(t, carry):
+        m, l, acc, k_cur, v_cur, mk_cur = carry
+        # rotate-then-process: n-1 rotations total (the naive
+        # process-then-rotate shape wastes a final dead K/V/mask transfer)
+        k_cur, v_cur, mk_cur = ring_shift((k_cur, v_cur, mk_cur), axis)
+        m, l, acc = process(t, m, l, acc, k_cur, v_cur, mk_cur)
+        return m, l, acc, k_cur, v_cur, mk_cur
+
+    # accumulators derived from q so they inherit q's varying-axes type
+    # (works whether the surrounding shard_map has one mesh axis or several)
+    qt = jnp.moveaxis(q[..., 0], 1, 2)  # (B, H, Lq)
+    m0 = jnp.full_like(qt, NEG_INF)
+    l0 = jnp.zeros_like(qt)
+    acc0 = jnp.zeros_like(q)
+    # block 0 (own K/V) costs no communication; the loop does the other n-1
+    m, l, acc = process(0, m0, l0, acc0, k, v, mask0)
+    if n > 1:
+        m, l, acc, _, _, _ = lax.fori_loop(1, n, body, (m, l, acc, k, v, mask0))
+    # rows with no unmasked key (impossible under causal self-attn, but keep
+    # the division safe) fall back to 0
+    l = jnp.maximum(l, 1e-30)
+    return acc / l.transpose(0, 2, 1)[..., None]
+
+
+def ulysses_attention(q, k, v, axis: str, causal: bool = False,
+                      scale: float | None = None, kv_mask=None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Reshard (B, L/n, H, D) → (B, L, H/n, D) with one `all_to_all`, run dense
+    attention on the full sequence for H/n heads, reshard back.  Two
+    all-to-alls per tensor vs n ppermute hops for ring — better when H
+    divides well and the full-sequence scores fit in memory.
+    """
+    n = lax.axis_size(axis)
+    if q.shape[2] % n != 0:
+        raise ValueError(f"num_heads {q.shape[2]} not divisible by axis size {n}")
+
+    def to_heads(x):  # (B, L/n, H, D) -> (B, L, H/n, D)
+        return lax.all_to_all(x, axis_name=axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(x):    # (B, L, H/n, D) -> (B, L/n, H, D)
+        return lax.all_to_all(x, axis_name=axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    full_mask = None
+    if kv_mask is not None:  # (B, L/n) → (B, L): every device needs all keys
+        full_mask = lax.all_gather(kv_mask, axis_name=axis, axis=1, tiled=True)
+    out = dense_attention(to_heads(q), to_heads(k), to_heads(v),
+                          causal=causal, scale=scale, kv_mask=full_mask)
+    return to_seq(out)

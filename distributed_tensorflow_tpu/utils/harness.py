@@ -50,6 +50,9 @@ class ExperimentConfig:
     model_fn: Callable | None = None       # user plug-in override (README.md:12)
     dataset_fn: Callable | None = None
     target_accuracy: float | None = None   # e.g. 0.97 for steps-to-97%
+    seq_parallel: int = 1                  # >1: shard sequences over a 'seq'
+                                           # mesh axis (long-context mode)
+    attention_impl: str = "ring"           # ring | ulysses (when seq_parallel>1)
 
 
 @dataclasses.dataclass
@@ -65,6 +68,8 @@ class _Experiment:
 
 
 def _setup(config: ExperimentConfig) -> _Experiment:
+    if config.seq_parallel > 1:
+        return _setup_seq_parallel(config)
     mesh = meshlib.create_mesh(config.n_devices)
     n = mesh.shape[meshlib.DATA_AXIS]
 
@@ -96,6 +101,50 @@ def _setup(config: ExperimentConfig) -> _Experiment:
                        engine=engine, global_batch=global_batch)
 
 
+def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
+    """Long-context mode: 2-D (data, seq) mesh + ring/Ulysses attention.
+
+    ``n_devices`` still plays the reference's -n role; ``seq_parallel`` of
+    them shard the sequence, the rest shard the batch."""
+    import jax as _jax
+
+    from distributed_tensorflow_tpu.engines.seq_parallel import SeqParallelEngine
+
+    if config.engine not in ("sync", "allreduce"):
+        raise ValueError(
+            f"seq_parallel>1 supports sync semantics only, got engine="
+            f"'{config.engine}' (async/gossip + sequence sharding is not "
+            f"implemented)")
+    total = config.n_devices or len(_jax.devices())
+    sp = config.seq_parallel
+    if total % sp != 0:
+        raise ValueError(f"n_devices {total} not divisible by seq_parallel {sp}")
+    dp = total // sp
+    mesh = meshlib.create_mesh(
+        total, shape=(dp, sp), axis_names=(meshlib.DATA_AXIS, meshlib.SEQ_AXIS))
+
+    if config.dataset_fn is not None:
+        train_ds = config.dataset_fn(config.batch_size, type="train")
+        test_ds = config.dataset_fn(config.eval_batch, type="test")
+    else:
+        train_ds = loaders.load_dataset(config.dataset, split="train")
+        test_ds = loaders.load_dataset(config.dataset, split="test")
+    if config.model_fn is not None:
+        model = config.model_fn()
+    else:
+        model = modellib.create_model(
+            config.model, num_classes=train_ds.num_classes,
+            attention_impl=config.attention_impl)
+
+    global_batch = max(
+        config.batch_size * dp if config.per_worker_batch else config.batch_size,
+        dp)
+    engine = SeqParallelEngine(model, mesh=mesh,
+                               learning_rate=config.learning_rate)
+    return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
+                       engine=engine, global_batch=global_batch)
+
+
 def run(config: ExperimentConfig) -> dict[str, Any]:
     """Run one experiment; returns the summary dict (also emitted as JSONL)."""
     ex = _setup(config)
@@ -114,17 +163,20 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     sink.results(ev["accuracy"], loss=ev["loss"])
 
     summary = {
-        "engine": config.engine,
+        "engine": config.engine if config.seq_parallel <= 1 else
+                  f"seq_parallel[{config.attention_impl}]",
         "model": config.model,
         "dataset": train_ds.name,
         "synthetic_data": train_ds.synthetic,
-        "n_devices": n,
+        "n_devices": n * config.seq_parallel,
+        "data_parallel": n,
+        "seq_parallel": config.seq_parallel,
         "global_batch": global_batch,
         "epochs": config.epochs,
         "steps": fit["steps"],
         "elapsed_s": fit["elapsed"],
         "examples_per_sec": fit["examples_per_sec"],
-        "examples_per_sec_per_device": fit["examples_per_sec"] / n,
+        "examples_per_sec_per_device": fit["examples_per_sec"] / (n * config.seq_parallel),
         "test_accuracy": ev["accuracy"],
         "test_loss": ev["loss"],
     }

@@ -57,8 +57,13 @@ def test_sync_params_stay_replicated(mesh8, data):
 
 
 def test_sync_matches_single_device_math(data):
-    """8-device pmean-sync must equal 1-device training on the same global
-    batch (the defining property of sync DP)."""
+    """8-device sync must equal 1-device training on the same global batch
+    (the defining property of sync DP).  SGD optimizer: linear in the
+    gradient, so a wrong grad SCALE fails the test — Adam's scale invariance
+    would mask exactly the bug this guards against (per-device loss must be
+    scaled 1/n because shard_map's AD transpose psums grads implicitly)."""
+    import optax
+
     from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
     train, _ = data
@@ -68,7 +73,7 @@ def test_sync_matches_single_device_math(data):
     for n in (1, 8):
         mesh = meshlib.create_mesh(n)
         model = create_model("mlp", num_classes=4, hidden=32, dropout_rate=0.0)
-        eng = SyncEngine(model, mesh=mesh)
+        eng = SyncEngine(model, optimizer=optax.sgd(0.5), mesh=mesh)
         state = eng.init_state(jax.random.key(0), x)
         for _ in range(3):
             xs, ys = eng.shard_batch(x, y)
