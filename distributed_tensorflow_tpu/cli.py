@@ -85,9 +85,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "mode; requires a sequence model, e.g. --model bert_tiny)")
     p.add_argument("--attention", default="ring", choices=["ring", "ulysses"],
                    help="sequence-parallel attention strategy")
+    p.add_argument("-tp", "--tensor-parallel", type=int, default=1,
+                   help="shard weight matrices over this many devices "
+                        "(Megatron-style TP; MLP family)")
     p.add_argument("--result-path", default=None, help="JSONL event sink path")
     p.add_argument("--log-every", type=int, default=50)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="enable TrainState checkpointing to this directory")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="steps between checkpoints (0: final only)")
+    p.add_argument("--resume", action="store_true",
+                   help="restore the latest checkpoint before training")
+    p.add_argument("--metrics-path", default=None,
+                   help="per-step metrics JSONL path")
+    p.add_argument("--profile-dir", default=None,
+                   help="write an XLA profiler trace here (TensorBoard/XProf)")
     return p
 
 
@@ -136,6 +149,12 @@ def main(argv: list[str] | None = None) -> dict:
         supervisor_address=None,
         seq_parallel=args.seq_parallel,
         attention_impl=args.attention,
+        tensor_parallel=args.tensor_parallel,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
+        metrics_path=args.metrics_path,
+        profile_dir=args.profile_dir,
     )
     summary = run(config)
     print(json.dumps(summary))

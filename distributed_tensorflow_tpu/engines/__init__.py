@@ -23,6 +23,8 @@ from distributed_tensorflow_tpu.engines.async_local import AsyncLocalEngine  # n
 from distributed_tensorflow_tpu.engines.gossip import GossipEngine  # noqa: F401
 from distributed_tensorflow_tpu.engines.allreduce import Trainer  # noqa: F401
 from distributed_tensorflow_tpu.engines.seq_parallel import SeqParallelEngine  # noqa: F401
+from distributed_tensorflow_tpu.engines.tensor_parallel import (  # noqa: F401
+    TensorParallelEngine, TPMLP)
 
 ENGINES = {
     "sync": SyncEngine,

@@ -35,9 +35,14 @@ class Trainer:
         self.history: list[dict] = []
 
     def fit(self, train_ds, epochs: int = 1, batch_size: int | None = None,
-            log_every: int = 50, log_fn: Callable[[str], None] = print) -> dict:
+            log_every: int = 50, log_fn: Callable[[str], None] = print,
+            checkpoint_manager=None, checkpoint_every: int = 0,
+            metrics_logger=None) -> dict:
         """Train; returns {'elapsed': seconds_around_fit, 'steps': n, ...} —
         the reference's only training metrics (reference dist_keras.py:41-49).
+
+        ``checkpoint_manager``/``checkpoint_every``: periodic TrainState
+        checkpoints (+ one final); ``metrics_logger``: per-step JSONL sink.
         """
         eng = self.engine
         bs = batch_size or train_ds.batch_size or 32
@@ -63,6 +68,17 @@ class Trainer:
                     jax.block_until_ready(in_flight.pop(0))
                 steps += 1
                 examples += len(bx)
+                if metrics_logger is not None and \
+                        steps % max(1, metrics_logger.log_every) == 0:
+                    # throttle-check BEFORE float(): forcing device values
+                    # every step would sync the host into the pipeline that
+                    # max_in_flight deliberately keeps async
+                    metrics_logger.log(steps,
+                                       **{k: float(v) for k, v in metrics.items()})
+                if checkpoint_manager is not None and checkpoint_every and \
+                        steps % checkpoint_every == 0:
+                    jax.block_until_ready(self.state)
+                    checkpoint_manager.save(self.state)
                 if log_every and steps % log_every == 0:
                     m = {k: float(v) for k, v in metrics.items()}
                     last_metrics = m
@@ -70,6 +86,8 @@ class Trainer:
                     log_fn(f"step {steps}  loss {m['loss']:.4f}  acc {m['accuracy']:.4f}")
         jax.block_until_ready(self.state)
         elapsed = time.perf_counter() - t0
+        if checkpoint_manager is not None:
+            checkpoint_manager.save(self.state)
         result = {
             "elapsed": elapsed, "steps": steps, "epochs": epochs,
             "examples": examples,

@@ -1,0 +1,138 @@
+"""Tensor parallelism via GSPMD auto-sharding.
+
+No reference counterpart (SURVEY.md §2.2: the reference's model is always
+replicated whole — reference client.py:72, server.py:150); this is TPU-native
+new capability: layers whose weight matrices exceed one device's HBM shard
+across a ``model`` mesh axis.
+
+Unlike the shard_map engines (explicit collectives, L1 layer), this engine
+uses the compiler-driven style — the "How to Scale Your Model" recipe: params
+carry `PartitionSpec` annotations (via `flax.linen.with_partitioning`), the
+batch is sharded over ``data``, everything runs under one `jax.jit`, and XLA
+GSPMD inserts the all-gathers/reduce-scatters itself.  Megatron layout for
+the MLP: first Dense column-parallel (hidden dim sharded), second Dense
+row-parallel (contraction dim sharded) — the activation between them stays
+sharded, and XLA emits exactly one psum on the way out.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import flax.linen as nn
+
+from distributed_tensorflow_tpu.engines.base import (
+    Engine, TrainState, cross_entropy)
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+
+class TPMLP(nn.Module):
+    """MLP with Megatron-style tensor-parallel annotations.
+
+    Same architecture as the reference default model_fn (reference
+    initializer.py:14-19: Flatten→Dense(512)→Dropout→Dense(10)), but the
+    hidden dimension is sharded over the 'model' mesh axis.
+    """
+
+    num_classes: int = 10
+    hidden: int = 512
+    dropout_rate: float = 0.2
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = x.reshape((x.shape[0], -1))
+        # column-parallel: kernel (in, hidden) sharded on hidden
+        x = nn.Dense(
+            self.hidden, dtype=self.dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), (None, meshlib.MODEL_AXIS)),
+            bias_init=nn.with_partitioning(
+                nn.initializers.zeros_init(), (meshlib.MODEL_AXIS,)),
+        )(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        # row-parallel: kernel (hidden, classes) sharded on hidden (the
+        # contraction dim) — XLA inserts the psum after the matmul
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), (meshlib.MODEL_AXIS, None)),
+        )(x)
+        return x.astype(jnp.float32)
+
+
+class TensorParallelEngine(Engine):
+    """Data×model parallel sync training under one jit (GSPMD).
+
+    ``mesh`` must have axes ('data', 'model').  The model's params may carry
+    `with_partitioning` annotations; unannotated params replicate.
+    """
+
+    def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3):
+        if mesh is None or set(mesh.axis_names) != {meshlib.DATA_AXIS,
+                                                    meshlib.MODEL_AXIS}:
+            raise ValueError("TensorParallelEngine requires a ('data','model') mesh")
+        super().__init__(model, optimizer, mesh, learning_rate)
+
+    def init_state(self, rng, sample_x) -> TrainState:
+        x = jnp.asarray(sample_x[:1])
+
+        def init_fn(rng):
+            variables = self.model.init(rng, x, train=False)
+            params = variables["params"]
+            opt_state = self.tx.init(params)
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=opt_state, rng=rng)
+
+        # abstract-eval to read the partitioning annotations, then jit-init
+        # with those shardings so large params materialize already sharded
+        abstract = jax.eval_shape(init_fn, rng)
+        specs = nn.get_partition_spec(abstract)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        state = jax.jit(init_fn, out_shardings=shardings)(rng)
+        return state
+
+    def _build_step(self):
+        apply_fn = self.model.apply
+        tx = self.tx
+        mesh = self.mesh
+
+        def train_step(state: TrainState, x, y):
+            rng = jax.random.fold_in(state.rng, state.step)
+
+            def loss_fn(params):
+                logits = apply_fn({"params": params}, x, train=True,
+                                  rngs={"dropout": rng})
+                loss = cross_entropy(logits, y).mean()
+                acc = (logits.argmax(-1) == y).mean()
+                return loss, acc
+
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return state.replace(step=state.step + 1, params=params,
+                                 opt_state=opt_state), \
+                {"loss": loss, "accuracy": acc}
+
+        # jit semantics are GLOBAL (unlike per-device shard_map): the loss is
+        # the global batch mean as written; GSPMD lowers the collectives
+        return jax.jit(train_step, donate_argnums=0)
+
+    def _build_eval(self):
+        apply_fn = self.model.apply
+
+        def eval_step(params, x, y, mask):
+            logits = apply_fn({"params": params}, x, train=False)
+            correct = ((logits.argmax(-1) == y) * mask).sum()
+            loss_sum = (cross_entropy(logits, y) * mask).sum()
+            return correct, loss_sum, mask.sum()
+
+        return jax.jit(eval_step)

@@ -1,0 +1,93 @@
+"""Checkpoint / resume.
+
+The reference has NO persistence: weights live only in process memory and
+cross the wire as pickle, never touching disk (SURVEY.md §5 — reference
+server.py:81, :104); any crash loses the run.  Here full TrainState
+(params + optimizer state + step + rng) checkpoints atomically via Orbax,
+with retention and resume — including per-device-stacked states from the
+async/gossip engines (Orbax gathers sharded arrays transparently).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+_STEP_DIR = re.compile(r"^step_(\d+)$")
+
+
+def _is_key(x) -> bool:
+    return hasattr(x, "dtype") and jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key)
+
+
+def _unkey(tree):
+    """Typed PRNG keys aren't serializable — store their raw uint32 data."""
+    return jax.tree.map(
+        lambda x: jax.random.key_data(x) if _is_key(x) else x, tree)
+
+
+def _rekey(template, tree):
+    return jax.tree.map(
+        lambda t, r: jax.random.wrap_key_data(jax.numpy.asarray(r))
+        if _is_key(t) else r,
+        template, tree)
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints under ``directory`` with retention."""
+
+    def __init__(self, directory: str | Path, max_to_keep: int = 3):
+        self.directory = Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self._ckptr = ocp.PyTreeCheckpointer()
+
+    # ------------------------------------------------------------------ save
+    def save(self, state: Any, step: int | None = None) -> Path:
+        if step is None:
+            step = int(jax.device_get(state.step).max())
+        path = self.directory / f"step_{step}"
+        self._ckptr.save(path, jax.device_get(_unkey(state)), force=True)
+        self._retain()
+        return path
+
+    def _retain(self) -> None:
+        steps = sorted(self.steps())
+        for s in steps[: -self.max_to_keep] if self.max_to_keep else []:
+            import shutil
+
+            shutil.rmtree(self.directory / f"step_{s}", ignore_errors=True)
+
+    # --------------------------------------------------------------- restore
+    def steps(self) -> list[int]:
+        out = []
+        for p in self.directory.iterdir():
+            m = _STEP_DIR.match(p.name)
+            if m and p.is_dir():
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def restore(self, template: Any, step: int | None = None) -> Any:
+        """Restore into the structure/shardings of ``template`` (a freshly
+        initialized TrainState — engine.init_state output)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        restored = self._ckptr.restore(
+            self.directory / f"step_{step}",
+            item=jax.device_get(_unkey(template)))
+        restored = _rekey(template, restored)
+        # re-place on device with the template's shardings
+        return jax.tree.map(
+            lambda t, r: jax.device_put(r, t.sharding)
+            if hasattr(t, "sharding") else r,
+            template, restored)

@@ -53,6 +53,13 @@ class ExperimentConfig:
     seq_parallel: int = 1                  # >1: shard sequences over a 'seq'
                                            # mesh axis (long-context mode)
     attention_impl: str = "ring"           # ring | ulysses (when seq_parallel>1)
+    tensor_parallel: int = 1               # >1: shard weights over a 'model'
+                                           # mesh axis (Megatron-style TP)
+    checkpoint_dir: str | None = None      # enable TrainState checkpointing
+    checkpoint_every: int = 0              # steps between checkpoints (0=end only)
+    resume: bool = False                   # restore latest checkpoint first
+    metrics_path: str | None = None        # per-step metrics JSONL
+    profile_dir: str | None = None         # XLA profiler trace output
 
 
 @dataclasses.dataclass
@@ -68,18 +75,17 @@ class _Experiment:
 
 
 def _setup(config: ExperimentConfig) -> _Experiment:
+    if config.seq_parallel > 1 and config.tensor_parallel > 1:
+        raise ValueError("seq_parallel and tensor_parallel are mutually "
+                         "exclusive in this release")
     if config.seq_parallel > 1:
         return _setup_seq_parallel(config)
+    if config.tensor_parallel > 1:
+        return _setup_tensor_parallel(config)
     mesh = meshlib.create_mesh(config.n_devices)
     n = mesh.shape[meshlib.DATA_AXIS]
 
-    if config.dataset_fn is not None:
-        train_ds = config.dataset_fn(config.batch_size, type="train")
-        test_ds = config.dataset_fn(config.eval_batch, type="test")
-    else:
-        train_ds = loaders.load_dataset(config.dataset, split="train")
-        test_ds = loaders.load_dataset(config.dataset, split="test")
-
+    train_ds, test_ds = _load_data(config)
     if config.model_fn is not None:
         model = config.model_fn()
     else:
@@ -88,8 +94,7 @@ def _setup(config: ExperimentConfig) -> _Experiment:
     # reference -b is the PER-WORKER batch (reference client.py:64 feeds each
     # worker's shard with batch_size b); global batch = b × n matches its
     # aggregate examples-per-round
-    global_batch = config.batch_size * n if config.per_worker_batch else config.batch_size
-    global_batch = max(global_batch, n)
+    global_batch = _global_batch(config, n)
 
     engine_kw: dict[str, Any] = dict(mesh=mesh, learning_rate=config.learning_rate)
     if config.engine == "async":
@@ -101,48 +106,91 @@ def _setup(config: ExperimentConfig) -> _Experiment:
                        engine=engine, global_batch=global_batch)
 
 
+def _load_data(config: ExperimentConfig):
+    if config.dataset_fn is not None:
+        return (config.dataset_fn(config.batch_size, type="train"),
+                config.dataset_fn(config.eval_batch, type="test"))
+    return (loaders.load_dataset(config.dataset, split="train"),
+            loaders.load_dataset(config.dataset, split="test"))
+
+
+def _global_batch(config: ExperimentConfig, dp: int) -> int:
+    return max(config.batch_size * dp if config.per_worker_batch
+               else config.batch_size, dp)
+
+
+def _split_mesh(config: ExperimentConfig, factor: int, factor_name: str,
+                second_axis: str):
+    """2-D (data, <second_axis>) mesh: factor devices on the second axis,
+    the rest on data.  Shared by the seq- and tensor-parallel setups."""
+    import jax as _jax
+
+    if config.engine not in ("sync", "allreduce"):
+        raise ValueError(
+            f"{factor_name}>1 supports sync semantics only, got "
+            f"engine='{config.engine}'")
+    total = config.n_devices or len(_jax.devices())
+    if total % factor != 0:
+        raise ValueError(f"n_devices {total} not divisible by {factor_name} {factor}")
+    dp = total // factor
+    mesh = meshlib.create_mesh(total, shape=(dp, factor),
+                               axis_names=(meshlib.DATA_AXIS, second_axis))
+    return mesh, dp
+
+
+_SEQUENCE_MODELS = ("bert_tiny", "bert")
+
+
 def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
     """Long-context mode: 2-D (data, seq) mesh + ring/Ulysses attention.
 
     ``n_devices`` still plays the reference's -n role; ``seq_parallel`` of
     them shard the sequence, the rest shard the batch."""
-    import jax as _jax
-
     from distributed_tensorflow_tpu.engines.seq_parallel import SeqParallelEngine
 
-    if config.engine not in ("sync", "allreduce"):
-        raise ValueError(
-            f"seq_parallel>1 supports sync semantics only, got engine="
-            f"'{config.engine}' (async/gossip + sequence sharding is not "
-            f"implemented)")
-    total = config.n_devices or len(_jax.devices())
-    sp = config.seq_parallel
-    if total % sp != 0:
-        raise ValueError(f"n_devices {total} not divisible by seq_parallel {sp}")
-    dp = total // sp
-    mesh = meshlib.create_mesh(
-        total, shape=(dp, sp), axis_names=(meshlib.DATA_AXIS, meshlib.SEQ_AXIS))
-
-    if config.dataset_fn is not None:
-        train_ds = config.dataset_fn(config.batch_size, type="train")
-        test_ds = config.dataset_fn(config.eval_batch, type="test")
-    else:
-        train_ds = loaders.load_dataset(config.dataset, split="train")
-        test_ds = loaders.load_dataset(config.dataset, split="test")
+    mesh, dp = _split_mesh(config, config.seq_parallel, "seq_parallel",
+                           meshlib.SEQ_AXIS)
+    train_ds, test_ds = _load_data(config)
     if config.model_fn is not None:
         model = config.model_fn()
-    else:
+    elif config.model in _SEQUENCE_MODELS:
         model = modellib.create_model(
             config.model, num_classes=train_ds.num_classes,
             attention_impl=config.attention_impl)
+    else:
+        raise ValueError(
+            f"seq_parallel needs a sequence model ({'/'.join(_SEQUENCE_MODELS)}), "
+            f"got --model {config.model}; pass model_fn for a custom model "
+            f"with attention_impl='ring'|'ulysses'")
 
-    global_batch = max(
-        config.batch_size * dp if config.per_worker_batch else config.batch_size,
-        dp)
     engine = SeqParallelEngine(model, mesh=mesh,
                                learning_rate=config.learning_rate)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
-                       engine=engine, global_batch=global_batch)
+                       engine=engine, global_batch=_global_batch(config, dp))
+
+
+def _setup_tensor_parallel(config: ExperimentConfig) -> _Experiment:
+    """Megatron-style TP: 2-D (data, model) mesh, weights sharded by GSPMD."""
+    from distributed_tensorflow_tpu.engines.tensor_parallel import (
+        TensorParallelEngine, TPMLP)
+
+    mesh, dp = _split_mesh(config, config.tensor_parallel, "tensor_parallel",
+                           meshlib.MODEL_AXIS)
+    train_ds, test_ds = _load_data(config)
+    if config.model_fn is not None:
+        model = config.model_fn()
+    elif config.model in ("mlp", "tp_mlp", "mnist_mlp"):
+        model = TPMLP(num_classes=train_ds.num_classes)
+    else:
+        raise ValueError(
+            f"tensor_parallel currently ships TP annotations for the MLP "
+            f"only (got --model {config.model}); pass model_fn with "
+            f"flax with_partitioning annotations for custom TP models")
+
+    engine = TensorParallelEngine(model, mesh=mesh,
+                                  learning_rate=config.learning_rate)
+    return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
+                       engine=engine, global_batch=_global_batch(config, dp))
 
 
 def run(config: ExperimentConfig) -> dict[str, Any]:
@@ -155,28 +203,67 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                       supervisor_address=config.supervisor_address)
     trainer = Trainer(None, engine=ex.engine, seed=config.seed)
 
+    ckpt_mgr = None
+    if config.resume and not config.checkpoint_dir:
+        raise ValueError("--resume requires --checkpoint-dir")
+    if config.checkpoint_dir:
+        from distributed_tensorflow_tpu.utils.checkpoint import CheckpointManager
+
+        ckpt_mgr = CheckpointManager(config.checkpoint_dir)
+        if config.resume:
+            if ckpt_mgr.latest_step() is None:
+                print(f"warning: --resume set but no checkpoint found under "
+                      f"{config.checkpoint_dir}; training from scratch")
+            else:
+                rng = jax.random.key(config.seed)
+                template = ex.engine.init_state(
+                    rng, train_ds.x[: max(1, ex.n)])
+                trainer.state = ckpt_mgr.restore(template)
+                sink.emit("resumed", step=ckpt_mgr.latest_step())
+
+    metrics_logger = None
+    if config.metrics_path:
+        from distributed_tensorflow_tpu.utils.metrics import MetricsLogger
+
+        metrics_logger = MetricsLogger(config.metrics_path,
+                                       log_every=max(1, config.log_every))
+
+    from distributed_tensorflow_tpu.utils.metrics import profile
+
     sink.start()
-    fit = trainer.fit(train_ds, epochs=config.epochs, batch_size=global_batch,
-                      log_every=config.log_every)
+    with profile(config.profile_dir):
+        fit = trainer.fit(train_ds, epochs=config.epochs,
+                          batch_size=global_batch,
+                          log_every=config.log_every,
+                          checkpoint_manager=ckpt_mgr,
+                          checkpoint_every=config.checkpoint_every,
+                          metrics_logger=metrics_logger)
     sink.done(fit["elapsed"])
     ev = trainer.evaluate(test_ds, batch_size=config.eval_batch)
     sink.results(ev["accuracy"], loss=ev["loss"])
 
+    if config.seq_parallel > 1:
+        engine_name = f"seq_parallel[{config.attention_impl}]"
+    elif config.tensor_parallel > 1:
+        engine_name = "tensor_parallel"
+    else:
+        engine_name = config.engine
+    total_devices = n * config.seq_parallel * config.tensor_parallel
     summary = {
-        "engine": config.engine if config.seq_parallel <= 1 else
-                  f"seq_parallel[{config.attention_impl}]",
+        "engine": engine_name,
         "model": config.model,
         "dataset": train_ds.name,
         "synthetic_data": train_ds.synthetic,
-        "n_devices": n * config.seq_parallel,
+        "n_devices": total_devices,
         "data_parallel": n,
         "seq_parallel": config.seq_parallel,
+        "tensor_parallel": config.tensor_parallel,
         "global_batch": global_batch,
         "epochs": config.epochs,
         "steps": fit["steps"],
         "elapsed_s": fit["elapsed"],
         "examples_per_sec": fit["examples_per_sec"],
-        "examples_per_sec_per_device": fit["examples_per_sec"] / (n * config.seq_parallel),
+        "examples_per_sec_per_device": fit["examples_per_sec"] / total_devices,
         "test_accuracy": ev["accuracy"],
         "test_loss": ev["loss"],
     }

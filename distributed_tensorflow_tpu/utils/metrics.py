@@ -1,0 +1,87 @@
+"""Metrics, step timing, and profiling.
+
+The reference's observability is print() plus one wall-clock window
+(SURVEY.md §5: server.py:72-119 prints; logging actively disabled in
+dist_keras.py:67-68).  Here: structured per-step metric records, step-time
+percentiles for the benchmark harness, and an XLA profiler hook
+(`jax.profiler.trace`) whose output loads in TensorBoard/XProf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from pathlib import Path
+from typing import Any, Iterator
+
+
+class StepTimer:
+    """Wall-clock per-step timing with percentile summary.
+
+    The reference times one global window between barriers (reference
+    server.py:76-79, 115-119); per-step percentiles additionally separate
+    compile (first step) from steady state."""
+
+    def __init__(self):
+        self.times: list[float] = []
+        self._t0: float | None = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.times.append(time.perf_counter() - self._t0)
+        self._t0 = None
+        return False
+
+    def summary(self) -> dict[str, float]:
+        if not self.times:
+            return {}
+        xs = sorted(self.times)
+        n = len(xs)
+        pick = lambda q: xs[min(n - 1, int(q * n))]  # noqa: E731
+        steady = xs[1:] if n > 1 else xs  # drop the compile step
+        return {
+            "steps": n,
+            "total_s": sum(self.times),
+            "first_step_s": self.times[0],  # includes XLA compile
+            "steady_mean_s": sum(steady) / len(steady),
+            "p50_s": pick(0.50),
+            "p90_s": pick(0.90),
+            "p99_s": pick(0.99),
+        }
+
+
+class MetricsLogger:
+    """JSONL per-step metrics sink (compose with utils.supervisor.ResultSink
+    for run-level events)."""
+
+    def __init__(self, path: str | Path | None = None, log_every: int = 1):
+        self.path = Path(path) if path else None
+        self.log_every = log_every
+        self.records: list[dict] = []
+
+    def log(self, step: int, **metrics: Any) -> None:
+        if self.log_every and step % self.log_every != 0:
+            return
+        rec = {"step": step, "time": time.time(),
+               **{k: float(v) for k, v in metrics.items()}}
+        self.records.append(rec)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+
+@contextlib.contextmanager
+def profile(trace_dir: str | Path | None) -> Iterator[None]:
+    """XLA profiler window; view with TensorBoard's profile plugin / XProf.
+    No-op when trace_dir is None."""
+    if trace_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(str(trace_dir)):
+        yield
