@@ -17,6 +17,7 @@ import jax
 import numpy as np
 
 from distributed_tensorflow_tpu.engines.sync import SyncEngine
+from distributed_tensorflow_tpu.utils.metrics import StepTimer
 
 
 class Trainer:
@@ -52,6 +53,12 @@ class Trainer:
             rng = jax.random.key(self.seed)
             sample = train_ds.x[: max(1, eng.n_devices)]
             self.state = eng.init_state(rng, sample)
+        # global step offset: nonzero after a checkpoint --resume, so metric
+        # records and checkpoint cadence continue the original numbering
+        # instead of restarting at 1
+        # (.reshape(-1)[0]: async engine's step is per-device, one per shard)
+        start_step = int(np.asarray(jax.device_get(self.state.step)).reshape(-1)[0])
+        timer = StepTimer()
         t0 = time.perf_counter()
         steps = 0
         examples = 0
@@ -61,37 +68,42 @@ class Trainer:
             for bx, by, _ in train_ds.batches(
                     bs, shuffle=True, seed=self.seed, epoch=epoch,
                     drop_remainder=True):
-                xs, ys = self.engine.shard_batch(bx, by)
-                self.state, metrics = eng.step(self.state, xs, ys)
-                in_flight.append(metrics)
-                if len(in_flight) > self.max_in_flight:
-                    jax.block_until_ready(in_flight.pop(0))
+                with timer:  # amortized dispatch+throttle time (see result)
+                    xs, ys = self.engine.shard_batch(bx, by)
+                    self.state, metrics = eng.step(self.state, xs, ys)
+                    in_flight.append(metrics)
+                    if len(in_flight) > self.max_in_flight:
+                        jax.block_until_ready(in_flight.pop(0))
                 steps += 1
+                gstep = start_step + steps
                 examples += len(bx)
-                if metrics_logger is not None and \
-                        steps % max(1, metrics_logger.log_every) == 0:
+                if metrics_logger is not None and metrics_logger.should_log(gstep):
                     # throttle-check BEFORE float(): forcing device values
                     # every step would sync the host into the pipeline that
                     # max_in_flight deliberately keeps async
-                    metrics_logger.log(steps,
+                    metrics_logger.log(gstep,
                                        **{k: float(v) for k, v in metrics.items()})
                 if checkpoint_manager is not None and checkpoint_every and \
-                        steps % checkpoint_every == 0:
+                        gstep % checkpoint_every == 0:
                     jax.block_until_ready(self.state)
                     checkpoint_manager.save(self.state)
                 if log_every and steps % log_every == 0:
                     m = {k: float(v) for k, v in metrics.items()}
                     last_metrics = m
                     # progress heartbeat — parity with reference client.py:92-94
-                    log_fn(f"step {steps}  loss {m['loss']:.4f}  acc {m['accuracy']:.4f}")
+                    log_fn(f"step {gstep}  loss {m['loss']:.4f}  acc {m['accuracy']:.4f}")
         jax.block_until_ready(self.state)
         elapsed = time.perf_counter() - t0
         if checkpoint_manager is not None:
             checkpoint_manager.save(self.state)
         result = {
             "elapsed": elapsed, "steps": steps, "epochs": epochs,
-            "examples": examples,
+            "start_step": start_step, "examples": examples,
             "examples_per_sec": examples / elapsed if elapsed > 0 else 0.0,
+            # per-step wall times: first_step_s isolates XLA compile; steady
+            # percentiles measure dispatch pace (device-throughput-bound once
+            # the max_in_flight window fills)
+            "step_time": timer.summary(),
             **{f"final_{k}": v for k, v in last_metrics.items()},
         }
         self.history.append(result)

@@ -102,7 +102,6 @@ class TensorParallelEngine(Engine):
     def _build_step(self):
         apply_fn = self.model.apply
         tx = self.tx
-        mesh = self.mesh
 
         def train_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)

@@ -7,6 +7,9 @@ Standard BERT-tiny shape: 2 layers, hidden 128, 2 heads, FFN 512.
 
 Attention is pluggable (``attention_impl``):
   'dense'   — ordinary full attention; any mesh, no seq sharding.
+  'flash'   — Pallas flash-attention kernel (ops.flash_attention): exact
+              same math as 'dense' but blockwise in VMEM — O(L) memory,
+              the TPU-native choice for long single-device sequences.
   'ring'    — ring attention over the ``seq`` mesh axis; the model must run
               inside `jax.shard_map` with the token dim sharded over 'seq'
               (see engines.seq_parallel).  K/V blocks rotate via ppermute.
@@ -49,6 +52,9 @@ class SelfAttention(nn.Module):
             out = ring_attention(q, k, v, axis=self.seq_axis, kv_mask=pad_mask)
         elif self.attention_impl == "ulysses":
             out = ulysses_attention(q, k, v, axis=self.seq_axis, kv_mask=pad_mask)
+        elif self.attention_impl == "flash":
+            from distributed_tensorflow_tpu.ops import flash_attention
+            out = flash_attention(q, k, v, kv_mask=pad_mask)
         else:
             prob_fn = None
             if self.dropout_rate > 0.0:

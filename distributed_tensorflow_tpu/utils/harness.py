@@ -206,6 +206,9 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     ckpt_mgr = None
     if config.resume and not config.checkpoint_dir:
         raise ValueError("--resume requires --checkpoint-dir")
+    if config.checkpoint_every and not config.checkpoint_dir:
+        raise ValueError("--checkpoint-every requires --checkpoint-dir "
+                         "(no checkpoints would be written otherwise)")
     if config.checkpoint_dir:
         from distributed_tensorflow_tpu.utils.checkpoint import CheckpointManager
 

@@ -63,8 +63,13 @@ class MetricsLogger:
         self.log_every = log_every
         self.records: list[dict] = []
 
+    def should_log(self, step: int) -> bool:
+        """Single home of the throttle policy — callers that must avoid even
+        *computing* metric values (host-device sync) check this first."""
+        return not self.log_every or step % self.log_every == 0
+
     def log(self, step: int, **metrics: Any) -> None:
-        if self.log_every and step % self.log_every != 0:
+        if not self.should_log(step):
             return
         rec = {"step": step, "time": time.time(),
                **{k: float(v) for k, v in metrics.items()}}

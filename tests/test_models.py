@@ -66,3 +66,15 @@ def test_bert_tiny_forward():
     padded = jnp.concatenate([ids, jnp.zeros((2, 4), jnp.int32)], axis=1)
     lp = model.apply({"params": params}, padded, train=False)
     np.testing.assert_allclose(logits, lp, atol=1e-4)
+
+
+def test_bert_flash_matches_dense():
+    """attention_impl='flash' (Pallas kernel) must agree with 'dense'."""
+    kw = dict(num_classes=2, vocab_size=100, max_len=32)
+    dense = create_model("bert_tiny", attention_impl="dense", **kw)
+    flash = create_model("bert_tiny", attention_impl="flash", **kw)
+    ids = jnp.array(np.random.default_rng(1).integers(1, 100, (2, 32)))
+    params = dense.init(jax.random.key(0), ids, train=False)["params"]
+    ld = dense.apply({"params": params}, ids, train=False)
+    lf = flash.apply({"params": params}, ids, train=False)
+    np.testing.assert_allclose(ld, lf, atol=1e-4, rtol=1e-4)
