@@ -88,6 +88,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-tp", "--tensor-parallel", type=int, default=1,
                    help="shard weight matrices over this many devices "
                         "(Megatron-style TP; MLP family)")
+    p.add_argument("-pp", "--pipeline-parallel", type=int, default=1,
+                   help="shard model stages over this many devices "
+                        "(GPipe-style microbatched pipeline)")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="pipeline microbatches per step (bubble = (S-1)/(M+S-1))")
     p.add_argument("--result-path", default=None, help="JSONL event sink path")
     p.add_argument("--log-every", type=int, default=50)
     p.add_argument("--seed", type=int, default=0)
@@ -150,6 +155,8 @@ def main(argv: list[str] | None = None) -> dict:
         seq_parallel=args.seq_parallel,
         attention_impl=args.attention,
         tensor_parallel=args.tensor_parallel,
+        pipeline_parallel=args.pipeline_parallel,
+        microbatches=args.microbatches,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,

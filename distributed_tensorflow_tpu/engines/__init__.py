@@ -25,6 +25,7 @@ from distributed_tensorflow_tpu.engines.allreduce import Trainer  # noqa: F401
 from distributed_tensorflow_tpu.engines.seq_parallel import SeqParallelEngine  # noqa: F401
 from distributed_tensorflow_tpu.engines.tensor_parallel import (  # noqa: F401
     TensorParallelEngine, TPMLP)
+from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine  # noqa: F401
 
 ENGINES = {
     "sync": SyncEngine,

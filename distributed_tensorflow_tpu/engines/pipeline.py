@@ -1,0 +1,256 @@
+"""Pipeline parallelism: GPipe-style microbatched collective pipelining.
+
+No reference counterpart (SURVEY.md §2.2: the reference's models are
+single-stage, reference initializer.py:14-19); this is TPU-native new
+capability completing the parallelism matrix.
+
+Design — the "collective pipeline" from the scaling playbook, written as ONE
+SPMD program under `jax.shard_map` over a ``('data', 'pipe')`` mesh:
+
+* Stage parameters are *stacked* with a leading stage dimension and sharded
+  ``P('pipe')`` — each device on the pipe axis holds exactly one stage.
+* The step splits its data-shard batch into M microbatches and runs a
+  ``lax.scan`` of ``M + S - 1`` ticks.  Every tick each device applies its
+  stage to the activation in its buffer, then the buffer rotates one hop
+  along the pipe axis via ``ppermute`` — activations ride ICI, never the
+  host.  Stage 0 injects microbatch ``i``; the last stage emits the loss for
+  microbatch ``i - (S - 1)``.  The bubble is the standard ``(S-1)/(M+S-1)``.
+* Backward is just ``jax.grad`` through the scan: the AD transpose of
+  ``ppermute`` is the reverse rotation, so the backward pipeline runs in the
+  opposite direction automatically — no hand-written schedule.
+* Gradients: stage params are pipe-varying (each stage's grad stays local);
+  embed/head params enter replicated, so the AD transpose psums their grads
+  over both mesh axes — the same implicit-allreduce mechanism the sync
+  engine documents (engines/sync.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import flax.linen as nn
+
+from distributed_tensorflow_tpu.engines.base import (
+    Engine, TrainState, cross_entropy)
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+
+class PipelineEmbed(nn.Module):
+    """Input stage: flatten → project to the pipeline's hidden width."""
+
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        return nn.relu(nn.Dense(self.hidden)(x))
+
+
+class PipelineBlock(nn.Module):
+    """One pipeline stage: pre-norm residual MLP block (hidden-preserving,
+    so every stage has identical parameter structure and can be stacked)."""
+
+    hidden: int = 128
+    expansion: int = 2
+
+    @nn.compact
+    def __call__(self, h):
+        y = nn.LayerNorm()(h)
+        y = nn.Dense(self.hidden * self.expansion)(y)
+        y = nn.relu(y)
+        y = nn.Dense(self.hidden)(y)
+        return h + y
+
+
+class PipelineHead(nn.Module):
+    """Output stage: norm → logits."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, h):
+        return nn.Dense(self.num_classes)(nn.LayerNorm()(h))
+
+
+def _pipe_spec_tree(tree):
+    """PartitionSpec tree: leaves under a 'blocks' dict key are stage-stacked
+    → sharded P('pipe') on the leading (stage) dim; everything else
+    replicated.  Works for params AND optimizer state (optax mu/nu mirror the
+    param tree, so their paths also contain the 'blocks' key)."""
+
+    def spec(path, leaf):
+        for k in path:
+            if isinstance(k, jax.tree_util.DictKey) and k.key == "blocks":
+                return P(meshlib.PIPE_AXIS)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
+class PipelineEngine(Engine):
+    """data × pipe parallel training of an embed → S blocks → head model.
+
+    ``mesh`` must have axes ('data', 'pipe'); the number of stages S is the
+    pipe-axis size.  ``microbatches`` (M) must divide the per-data-shard
+    batch.  Throughput approaches M/(M+S-1) of bubble-free as M grows.
+    """
+
+    def __init__(
+        self,
+        num_classes: int = 10,
+        hidden: int = 128,
+        microbatches: int = 4,
+        optimizer=None,
+        mesh=None,
+        learning_rate: float = 1e-3,
+        expansion: int = 2,
+    ):
+        if mesh is None or set(mesh.axis_names) != {meshlib.DATA_AXIS,
+                                                    meshlib.PIPE_AXIS}:
+            raise ValueError("PipelineEngine requires a ('data','pipe') mesh")
+        self.embed = PipelineEmbed(hidden=hidden)
+        self.block = PipelineBlock(hidden=hidden, expansion=expansion)
+        self.head = PipelineHead(num_classes=num_classes)
+        self.n_stages = mesh.shape[meshlib.PIPE_AXIS]
+        self.microbatches = microbatches
+        super().__init__(model=None, optimizer=optimizer, mesh=mesh,
+                         learning_rate=learning_rate)
+
+    # ---------------------------------------------------------------- init
+    def init_state(self, rng, sample_x) -> TrainState:
+        x = jnp.asarray(sample_x[:1])
+        e_rng, b_rng, h_rng = jax.random.split(rng, 3)
+        embed_p = self.embed.init(e_rng, x)["params"]
+        h = self.embed.apply({"params": embed_p}, x)
+        blocks_p = jax.vmap(
+            lambda k: self.block.init(k, h)["params"]
+        )(jax.random.split(b_rng, self.n_stages))
+        head_p = self.head.init(h_rng, h)["params"]
+        params = {"embed": embed_p, "blocks": blocks_p, "head": head_p}
+        opt_state = self.tx.init(params)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           opt_state=opt_state, rng=rng)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), _pipe_spec_tree(state),
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(state, shardings)
+
+    # ------------------------------------------------------------- forward
+    def _sequential_logits(self, params, x):
+        """Un-pipelined forward (scan over the stacked stages) — used for
+        eval and as the parity oracle in tests."""
+        h = self.embed.apply({"params": params["embed"]}, x)
+
+        def body(h, bp):
+            return self.block.apply({"params": bp}, h), None
+
+        h, _ = lax.scan(body, h, params["blocks"])
+        return self.head.apply({"params": params["head"]}, h)
+
+    # ---------------------------------------------------------------- step
+    def _build_step(self):
+        tx = self.tx
+        embed, block, head = self.embed, self.block, self.head
+        M = self.microbatches
+        data_axis, pipe_axis = meshlib.DATA_AXIS, meshlib.PIPE_AXIS
+
+        def device_step(state: TrainState, x, y):
+            S = lax.axis_size(pipe_axis)
+            n_data = lax.axis_size(data_axis)
+            stage = lax.axis_index(pipe_axis)
+            mb = x.shape[0] // M
+            micro_x = x.reshape((M, mb) + x.shape[1:])
+            micro_y = y.reshape((M, mb))
+            perm = [(i, (i + 1) % S) for i in range(S)]
+
+            def loss_fn(params):
+                blocks_local = jax.tree.map(lambda a: a[0], params["blocks"])
+
+                def tick(buf, i):
+                    # stage 0 injects microbatch i (clamped past the drain)
+                    xi = lax.dynamic_index_in_dim(
+                        micro_x, jnp.clip(i, 0, M - 1), keepdims=False)
+                    h_src = embed.apply({"params": params["embed"]}, xi)
+                    h_src = lax.pcast(h_src, pipe_axis, to="varying")
+                    h_in = jnp.where(stage == 0, h_src, buf)
+                    h_out = block.apply({"params": blocks_local}, h_in)
+                    # last stage drains microbatch i-(S-1)
+                    oi = i - (S - 1)
+                    yi = lax.dynamic_index_in_dim(
+                        micro_y, jnp.clip(oi, 0, M - 1), keepdims=False)
+                    yi = lax.pcast(yi, pipe_axis, to="varying")
+                    logits = head.apply({"params": params["head"]}, h_out)
+                    w = ((oi >= 0) & (oi < M) & (stage == S - 1)).astype(
+                        jnp.float32)
+                    loss_i = cross_entropy(logits, yi).mean() * w
+                    acc_i = (logits.argmax(-1) == yi).mean(
+                        ).astype(jnp.float32) * w
+                    buf_next = lax.ppermute(h_out, axis_name=pipe_axis,
+                                            perm=perm)
+                    return buf_next, (loss_i, acc_i, w)
+
+                buf0 = jnp.zeros((mb, block.hidden), jnp.float32)
+                buf0 = lax.pcast(buf0, (data_axis, pipe_axis), to="varying")
+                _, (losses, accs, ws) = lax.scan(
+                    tick, buf0, jnp.arange(M + S - 1))
+                # nonzero only on the last stage; scale so the implicit psum
+                # over BOTH axes at the AD boundary yields the global batch
+                # mean (same mechanism as engines/sync.py)
+                local_sum = losses.sum()
+                scaled = local_sum / (M * n_data)
+                return scaled, (losses.sum(), accs.sum(), ws.sum())
+
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            (_, (loss_sum, acc_sum, w_sum)), grads = grad_fn(state.params)
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
+            both = (data_axis, pipe_axis)
+            # w_sum depends only on the stage index → data-invariant; make it
+            # data-varying so it can ride the same two-axis psum
+            w_sum = lax.pcast(w_sum, data_axis, to="varying")
+            tot_w = lax.psum(w_sum, both)
+            metrics = {
+                "loss": lax.psum(loss_sum, both) / tot_w,
+                "accuracy": lax.psum(acc_sum, both) / tot_w,
+            }
+            new_state = state.replace(step=state.step + 1, params=params,
+                                      opt_state=opt_state)
+            return new_state, metrics
+
+        # the in/out spec trees depend on the concrete state structure, so
+        # the shard_map is built lazily on first call
+        compiled = {}
+
+        def step_fn(state, x, y):
+            if "fn" not in compiled:
+                spec = _pipe_spec_tree(state)
+                smapped = jax.shard_map(
+                    device_step, mesh=self.mesh,
+                    in_specs=(spec, P(data_axis), P(data_axis)),
+                    out_specs=(spec, P()),
+                )
+                compiled["fn"] = jax.jit(smapped, donate_argnums=0)
+            return compiled["fn"](state, x, y)
+
+        return step_fn
+
+    # ---------------------------------------------------------------- eval
+    def eval_params(self, state: TrainState):
+        return state.params
+
+    def _build_eval(self):
+        def eval_step(params, x, y, mask):
+            logits = self._sequential_logits(params, x)
+            correct = ((logits.argmax(-1) == y) * mask).sum()
+            loss_sum = (cross_entropy(logits, y) * mask).sum()
+            return correct, loss_sum, mask.sum()
+
+        # GSPMD jit: blocks stay sharded over 'pipe'; XLA moves stage params
+        # to where the scan needs them
+        return jax.jit(eval_step)

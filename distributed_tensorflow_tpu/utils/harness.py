@@ -55,6 +55,10 @@ class ExperimentConfig:
     attention_impl: str = "ring"           # ring | ulysses (when seq_parallel>1)
     tensor_parallel: int = 1               # >1: shard weights over a 'model'
                                            # mesh axis (Megatron-style TP)
+    pipeline_parallel: int = 1             # >1: shard stages over a 'pipe'
+                                           # mesh axis (GPipe microbatching)
+    microbatches: int = 4                  # pipeline microbatches per step
+    pipeline_hidden: int = 128             # pipeline stage width
     checkpoint_dir: str | None = None      # enable TrainState checkpointing
     checkpoint_every: int = 0              # steps between checkpoints (0=end only)
     resume: bool = False                   # restore latest checkpoint first
@@ -75,13 +79,17 @@ class _Experiment:
 
 
 def _setup(config: ExperimentConfig) -> _Experiment:
-    if config.seq_parallel > 1 and config.tensor_parallel > 1:
-        raise ValueError("seq_parallel and tensor_parallel are mutually "
-                         "exclusive in this release")
+    multi = [f for f in ("seq_parallel", "tensor_parallel", "pipeline_parallel")
+             if getattr(config, f) > 1]
+    if len(multi) > 1:
+        raise ValueError(f"{' and '.join(multi)} are mutually exclusive in "
+                         "this release")
     if config.seq_parallel > 1:
         return _setup_seq_parallel(config)
     if config.tensor_parallel > 1:
         return _setup_tensor_parallel(config)
+    if config.pipeline_parallel > 1:
+        return _setup_pipeline_parallel(config)
     mesh = meshlib.create_mesh(config.n_devices)
     n = mesh.shape[meshlib.DATA_AXIS]
 
@@ -193,6 +201,32 @@ def _setup_tensor_parallel(config: ExperimentConfig) -> _Experiment:
                        engine=engine, global_batch=_global_batch(config, dp))
 
 
+def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
+    """GPipe mode: 2-D (data, pipe) mesh; the engine owns its own
+    embed → stages → head model (stage-stacked params)."""
+    from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
+
+    mesh, dp = _split_mesh(config, config.pipeline_parallel,
+                           "pipeline_parallel", meshlib.PIPE_AXIS)
+    train_ds, test_ds = _load_data(config)
+    if config.model_fn is not None or config.model not in (
+            "mlp", "mnist_mlp", "pipeline_mlp"):
+        raise ValueError(
+            f"pipeline_parallel builds its own stage-stacked MLP model "
+            f"(got --model {config.model}); custom models need "
+            f"hidden-preserving stages — subclass PipelineEngine")
+    if (_global_batch(config, dp) // dp) % config.microbatches:
+        raise ValueError(
+            f"per-data-shard batch {_global_batch(config, dp) // dp} not "
+            f"divisible by microbatches {config.microbatches}")
+    engine = PipelineEngine(num_classes=train_ds.num_classes,
+                            hidden=config.pipeline_hidden,
+                            microbatches=config.microbatches, mesh=mesh,
+                            learning_rate=config.learning_rate)
+    return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
+                       engine=engine, global_batch=_global_batch(config, dp))
+
+
 def run(config: ExperimentConfig) -> dict[str, Any]:
     """Run one experiment; returns the summary dict (also emitted as JSONL)."""
     ex = _setup(config)
@@ -249,9 +283,12 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         engine_name = f"seq_parallel[{config.attention_impl}]"
     elif config.tensor_parallel > 1:
         engine_name = "tensor_parallel"
+    elif config.pipeline_parallel > 1:
+        engine_name = "pipeline_parallel"
     else:
         engine_name = config.engine
-    total_devices = n * config.seq_parallel * config.tensor_parallel
+    total_devices = (n * config.seq_parallel * config.tensor_parallel
+                     * config.pipeline_parallel)
     summary = {
         "engine": engine_name,
         "model": config.model,
@@ -261,6 +298,9 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         "data_parallel": n,
         "seq_parallel": config.seq_parallel,
         "tensor_parallel": config.tensor_parallel,
+        "pipeline_parallel": config.pipeline_parallel,
+        "microbatches": (config.microbatches
+                         if config.pipeline_parallel > 1 else None),
         "global_batch": global_batch,
         "epochs": config.epochs,
         "steps": fit["steps"],
