@@ -106,6 +106,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-step metrics JSONL path")
     p.add_argument("--profile-dir", default=None,
                    help="write an XLA profiler trace here (TensorBoard/XProf)")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "f32", "bfloat16", "bf16"],
+                   help="model compute dtype; bfloat16 = mixed precision "
+                        "(f32 params, bf16 activations on the MXU)")
     return p
 
 
@@ -162,6 +166,7 @@ def main(argv: list[str] | None = None) -> dict:
         resume=args.resume,
         metrics_path=args.metrics_path,
         profile_dir=args.profile_dir,
+        dtype=args.dtype,
     )
     summary = run(config)
     print(json.dumps(summary))

@@ -55,16 +55,49 @@ class Dataset:
         )
 
     def batches(self, batch_size: int | None = None, *, shuffle: bool = True,
-                seed: int = 0, epoch: int = 0, drop_remainder: bool = False):
+                seed: int = 0, epoch: int = 0, drop_remainder: bool = False,
+                native: bool | None = None):
+        """Iterate (x, y, mask) batches for one epoch.
+
+        ``native=None`` (default) uses the C++ prefetching pipeline when the
+        native library is available AND the host has >1 core (the prefetch
+        thread needs a core of its own to overlap with the training step;
+        measured a wash on 1-core hosts), falling back to the pure-Python
+        path; True requires the native path; False forces Python.  Both
+        paths yield byte-identical batches (tests/test_native.py).
+        """
         from distributed_tensorflow_tpu.data.pipeline import iter_batches
 
         bs = batch_size or self.batch_size
         if bs is None:
             raise ValueError("batch_size not set; pass it or use with_batching()")
+        if native is None and (os.cpu_count() or 1) < 2:
+            native = False
+        if native is not False:
+            try:
+                nb = self._native_batcher(bs)
+                return nb.epoch(shuffle=shuffle, seed=seed, epoch=epoch,
+                                drop_remainder=drop_remainder)
+            except RuntimeError:
+                if native:
+                    raise
         return iter_batches(
             self.x, self.y, bs, shuffle=shuffle, seed=seed, epoch=epoch,
             drop_remainder=drop_remainder,
         )
+
+    def _native_batcher(self, batch_size: int):
+        """Cached per-batch-size native pipeline — reusing it across epochs
+        keeps one C++ thread pool + staging buffers (and, for sharded
+        datasets, one contiguous copy) alive for the whole run."""
+        cache = self.__dict__.setdefault("_batcher_cache", {})
+        nb = cache.get(batch_size)
+        if nb is None:
+            from distributed_tensorflow_tpu.native.batcher import NativeBatcher
+
+            nb = NativeBatcher(self.x, self.y, batch_size)
+            cache[batch_size] = nb
+        return nb
 
 
 def _search_dirs() -> list[Path]:

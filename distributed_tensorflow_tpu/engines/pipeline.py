@@ -44,11 +44,12 @@ class PipelineEmbed(nn.Module):
     """Input stage: flatten → project to the pipeline's hidden width."""
 
     hidden: int = 128
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        x = x.reshape((x.shape[0], -1))
-        return nn.relu(nn.Dense(self.hidden)(x))
+        x = x.astype(self.dtype).reshape((x.shape[0], -1))
+        return nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
 
 
 class PipelineBlock(nn.Module):
@@ -57,24 +58,28 @@ class PipelineBlock(nn.Module):
 
     hidden: int = 128
     expansion: int = 2
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, h):
-        y = nn.LayerNorm()(h)
-        y = nn.Dense(self.hidden * self.expansion)(y)
+        y = nn.LayerNorm(dtype=self.dtype)(h)
+        y = nn.Dense(self.hidden * self.expansion, dtype=self.dtype)(y)
         y = nn.relu(y)
-        y = nn.Dense(self.hidden)(y)
+        y = nn.Dense(self.hidden, dtype=self.dtype)(y)
         return h + y
 
 
 class PipelineHead(nn.Module):
-    """Output stage: norm → logits."""
+    """Output stage: norm → logits (always f32 for a stable softmax)."""
 
     num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, h):
-        return nn.Dense(self.num_classes)(nn.LayerNorm()(h))
+        logits = nn.Dense(self.num_classes, dtype=self.dtype)(
+            nn.LayerNorm(dtype=self.dtype)(h))
+        return logits.astype(jnp.float32)
 
 
 def _pipe_spec_tree(tree):
@@ -109,13 +114,14 @@ class PipelineEngine(Engine):
         mesh=None,
         learning_rate: float = 1e-3,
         expansion: int = 2,
+        dtype: jnp.dtype = jnp.float32,
     ):
         if mesh is None or set(mesh.axis_names) != {meshlib.DATA_AXIS,
                                                     meshlib.PIPE_AXIS}:
             raise ValueError("PipelineEngine requires a ('data','pipe') mesh")
-        self.embed = PipelineEmbed(hidden=hidden)
-        self.block = PipelineBlock(hidden=hidden, expansion=expansion)
-        self.head = PipelineHead(num_classes=num_classes)
+        self.embed = PipelineEmbed(hidden=hidden, dtype=dtype)
+        self.block = PipelineBlock(hidden=hidden, expansion=expansion, dtype=dtype)
+        self.head = PipelineHead(num_classes=num_classes, dtype=dtype)
         self.n_stages = mesh.shape[meshlib.PIPE_AXIS]
         self.microbatches = microbatches
         super().__init__(model=None, optimizer=optimizer, mesh=mesh,

@@ -12,11 +12,34 @@ from __future__ import annotations
 from typing import Callable
 
 import flax.linen as nn
+import jax.numpy as jnp
 
 from distributed_tensorflow_tpu.models.mlp import MLP
 from distributed_tensorflow_tpu.models.cnn import CNN
 
 _REGISTRY: dict[str, Callable[..., nn.Module]] = {}
+
+_DTYPES = {
+    "float32": jnp.float32, "f32": jnp.float32, "fp32": jnp.float32,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "float16": jnp.float16, "f16": jnp.float16, "fp16": jnp.float16,
+}
+
+
+def resolve_dtype(dtype) -> jnp.dtype:
+    """Map a CLI string ('bfloat16', 'bf16', ...) or dtype to a jnp dtype.
+
+    Mixed precision on TPU: models compute in ``dtype`` (bf16 feeds the MXU
+    at full rate and halves HBM traffic for activations) while flax keeps
+    parameters in float32 (``param_dtype`` default), so optimizer math and
+    gradient accumulation stay full-precision.
+    """
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _DTYPES:
+            raise KeyError(f"unknown dtype '{dtype}'; known: {sorted(_DTYPES)}")
+        return _DTYPES[key]
+    return dtype
 
 
 def register(name: str):
@@ -48,6 +71,8 @@ def _fashion_mlp(num_classes: int = 10, **kw) -> nn.Module:
 
 def create_model(name: str, num_classes: int = 10, **kw) -> nn.Module:
     """Instantiate a registered model (lazy imports keep startup light)."""
+    if "dtype" in kw:
+        kw["dtype"] = resolve_dtype(kw["dtype"])
     if name in ("resnet20", "resnet"):
         from distributed_tensorflow_tpu.models.resnet import ResNet20
 

@@ -1,0 +1,118 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's runtime-around-the-compute is Python sockets + tf.data
+(/root/reference/centralized/network.py, initializer.py:24-55).  Here the
+equivalent runtime pieces are C++:
+
+  src/wire.cc      — framed socket transport (byte-compatible with the
+                     reference's 4-byte big-endian framing)
+  src/pipeline.cc  — multithreaded batch-gather input pipeline with a
+                     bounded prefetch queue (overlaps host input prep with
+                     device steps)
+
+The library builds on demand with g++ (baked into the image; pybind11 is
+not, so the ABI is plain C + ctypes).  Everything degrades gracefully: if
+the toolchain or a build is unavailable, ``load()`` returns None and pure
+Python paths take over.  Set ``DTF_TPU_NO_NATIVE=1`` to force Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+_SRC_DIR = Path(__file__).parent / "src"
+_LIB_NAME = "libdtf_native.so"
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _lib_path() -> Path:
+    return Path(__file__).parent / "_build" / _LIB_NAME
+
+
+def build(force: bool = False) -> Path | None:
+    """Compile src/*.cc into the package-local _build/ dir; None on failure."""
+    out = _lib_path()
+    sources = sorted(_SRC_DIR.glob("*.cc"))
+    if not sources:
+        return None
+    if out.exists() and not force:
+        newest = max(s.stat().st_mtime for s in sources)
+        if out.stat().st_mtime >= newest:
+            return out
+    out.parent.mkdir(parents=True, exist_ok=True)
+    # atomic build: compile to a temp name, rename over (parallel pytest safe)
+    with tempfile.NamedTemporaryFile(
+            dir=out.parent, suffix=".so", delete=False) as tmp:
+        tmp_path = Path(tmp.name)
+    cmd = [
+        os.environ.get("CXX", "g++"), "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-pthread", "-Wall", *map(str, sources), "-o", str(tmp_path),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        tmp_path.unlink(missing_ok=True)
+        return None
+    tmp_path.replace(out)
+    return out
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None when unavailable."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed or os.environ.get("DTF_TPU_NO_NATIVE"):
+        return None
+    path = build()
+    if path is None:
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError:
+        _load_failed = True
+        return None
+    _declare(lib)
+    _lib = lib
+    return lib
+
+
+def is_available() -> bool:
+    return load() is not None
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    # wire.cc
+    lib.dtw_send_frame.argtypes = [c.c_int, c.c_char_p, c.c_uint32]
+    lib.dtw_send_frame.restype = c.c_int64
+    lib.dtw_recv_frame.argtypes = [c.c_int, c.c_void_p, c.c_uint32]
+    lib.dtw_recv_frame.restype = c.c_int64
+    lib.dtw_peek_len.argtypes = [c.c_int]
+    lib.dtw_peek_len.restype = c.c_int64
+    lib.dtw_connect.argtypes = [c.c_char_p, c.c_int]
+    lib.dtw_connect.restype = c.c_int64
+    lib.dtw_listen.argtypes = [c.c_int]
+    lib.dtw_listen.restype = c.c_int64
+    lib.dtw_port.argtypes = [c.c_int]
+    lib.dtw_port.restype = c.c_int64
+    lib.dtw_accept.argtypes = [c.c_int]
+    lib.dtw_accept.restype = c.c_int64
+    lib.dtw_close.argtypes = [c.c_int]
+    lib.dtw_close.restype = c.c_int64
+    # pipeline.cc
+    lib.dtp_create.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int64,
+                               c.c_int64, c.c_int, c.c_int]
+    lib.dtp_create.restype = c.c_void_p
+    lib.dtp_start_epoch.argtypes = [c.c_void_p, c.c_void_p, c.c_int64]
+    lib.dtp_start_epoch.restype = c.c_int64
+    lib.dtp_next.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
+    lib.dtp_next.restype = c.c_int64
+    lib.dtp_destroy.argtypes = [c.c_void_p]
+    lib.dtp_destroy.restype = None

@@ -1,0 +1,97 @@
+"""Python wrapper over the native prefetching batch pipeline.
+
+Byte-identical semantics to the pure-Python ``data.pipeline.iter_batches``
+(same numpy permutation, same final-batch zero-padding + mask — the padding
+contract documented there), but the permutation-indexed row gather runs on a
+C++ thread pool and batches are staged in a bounded prefetch queue, so the
+next batch is already assembled while the device executes the current step.
+The reference's input path has no such overlap — tf.data prep and training
+interleave on the same Python process (reference initializer.py:24-55).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Iterator
+
+import numpy as np
+
+from distributed_tensorflow_tpu import native
+
+Batch = tuple[np.ndarray, np.ndarray, np.ndarray]
+
+
+class NativeBatcher:
+    """Reusable pipeline over one in-memory dataset.
+
+    Keeps the dataset arrays alive for the C++ side and reuses the staging
+    buffers across epochs.  Not thread-safe; one consumer at a time.
+    """
+
+    def __init__(self, x: np.ndarray, y: np.ndarray, batch_size: int,
+                 gather_threads: int | None = None, prefetch_depth: int = 2):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        self._lib = lib
+        # C-contiguous views the C++ side will index into (kept alive here)
+        self._x = np.ascontiguousarray(x)
+        self._y = np.ascontiguousarray(y, dtype=np.int32)
+        self.batch_size = batch_size
+        self.row_shape = self._x.shape[1:]
+        self._row_bytes = self._x.itemsize * int(np.prod(self.row_shape, dtype=np.int64))
+        if gather_threads is None:
+            gather_threads = min(8, os.cpu_count() or 1)
+        self._handle = lib.dtp_create(
+            self._x.ctypes.data_as(ctypes.c_void_p),
+            self._y.ctypes.data_as(ctypes.c_void_p),
+            len(self._x), self._row_bytes, batch_size,
+            gather_threads, prefetch_depth)
+        if not self._handle:
+            raise RuntimeError("dtp_create failed")
+        self._full_mask = np.ones(batch_size, np.float32)
+
+    def epoch(self, *, shuffle: bool = True, seed: int = 0, epoch: int = 0,
+              drop_remainder: bool = False) -> Iterator[Batch]:
+        """Yield (x, y, mask) batches for one epoch — the iter_batches contract."""
+        n = len(self._x)
+        idx = np.arange(n, dtype=np.int64)
+        if shuffle:
+            # identical permutation to data.pipeline.iter_batches
+            np.random.default_rng((seed, epoch)).shuffle(idx)
+        rc = self._lib.dtp_start_epoch(
+            self._handle, idx.ctypes.data_as(ctypes.c_void_p), n)
+        if rc != 0:
+            raise RuntimeError(f"dtp_start_epoch failed ({rc})")
+        while True:
+            # fresh arrays per batch: dtp_next fills them directly, so the
+            # consumer owns the memory (no copy-out, no reuse hazards)
+            out_x = np.empty((self.batch_size, *self.row_shape), self._x.dtype)
+            out_y = np.empty(self.batch_size, np.int32)
+            rows = self._lib.dtp_next(
+                self._handle,
+                out_x.ctypes.data_as(ctypes.c_void_p),
+                out_y.ctypes.data_as(ctypes.c_void_p))
+            if rows <= 0:
+                return
+            if rows < self.batch_size:
+                if drop_remainder:
+                    return
+                out_x[rows:] = 0
+                out_y[rows:] = 0
+                mask = np.zeros(self.batch_size, np.float32)
+                mask[:rows] = 1.0
+                yield out_x, out_y, mask
+                return
+            yield out_x, out_y, self._full_mask.copy()
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.dtp_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        self.close()

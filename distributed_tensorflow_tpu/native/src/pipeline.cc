@@ -1,0 +1,230 @@
+// Native host input pipeline: multithreaded batch gather with prefetch.
+//
+// TPU-native equivalent of the reference's tf.data input path
+// (/root/reference/initializer.py:24-55: shard → batch → shuffle).  The
+// device step consumes one global batch per step; this runtime gathers the
+// next batches' rows (a permutation-indexed gather over the in-memory
+// dataset) on a C++ thread pool and stages them in a bounded prefetch queue,
+// so host input prep overlaps device compute instead of serializing with it.
+//
+// Determinism contract: the permutation is COMPUTED IN PYTHON (same
+// numpy-seeded order as the pure-Python pipeline) and passed in, so native
+// and Python paths yield byte-identical epochs; C++ owns only the parallel
+// gather and the staging queue.
+//
+// C ABI for ctypes.  One producer thread slices each batch across a small
+// worker pool; `dtp_next` pops the oldest staged batch (blocking) and
+// recycles its buffer.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Buffer {
+  std::vector<uint8_t> x;
+  std::vector<int32_t> y;
+  int64_t rows = 0;
+};
+
+struct Pipeline {
+  const uint8_t* x = nullptr;   // dataset examples, row-major contiguous
+  const int32_t* y = nullptr;   // labels
+  int64_t n = 0;                // dataset rows
+  int64_t row_bytes = 0;        // bytes per example
+  int64_t batch = 0;            // rows per full batch
+  int gather_threads = 1;
+
+  std::vector<int64_t> perm;    // epoch order (set by dtp_start_epoch)
+  int64_t cursor = 0;           // next row index into perm
+
+  // staging queue: producer fills free buffers, consumer pops ready ones
+  std::deque<Buffer*> ready;
+  std::deque<Buffer*> free_bufs;
+  std::vector<Buffer> pool;
+
+  std::mutex mu;
+  std::condition_variable cv_ready;
+  std::condition_variable cv_free;
+  std::thread producer;
+  bool epoch_active = false;    // producer has batches left to stage
+  bool abort = false;           // unblock+exit producer (epoch restart)
+  bool shutdown = false;
+
+  ~Pipeline() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      shutdown = true;
+    }
+    cv_free.notify_all();
+    cv_ready.notify_all();
+    if (producer.joinable()) producer.join();
+  }
+};
+
+// Parallel row gather: out[i] = x[idx[i]] for i in [0, rows).
+void gather_rows(const Pipeline& p, const int64_t* idx, int64_t rows,
+                 uint8_t* out_x, int32_t* out_y) {
+  int threads = p.gather_threads;
+  if (threads <= 1 || rows < 2 * threads) {
+    for (int64_t i = 0; i < rows; ++i) {
+      std::memcpy(out_x + i * p.row_bytes, p.x + idx[i] * p.row_bytes,
+                  static_cast<size_t>(p.row_bytes));
+      out_y[i] = p.y[idx[i]];
+    }
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(static_cast<size_t>(threads) - 1);
+  int64_t chunk = (rows + threads - 1) / threads;
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      std::memcpy(out_x + i * p.row_bytes, p.x + idx[i] * p.row_bytes,
+                  static_cast<size_t>(p.row_bytes));
+      out_y[i] = p.y[idx[i]];
+    }
+  };
+  for (int t = 1; t < threads; ++t) {
+    int64_t lo = t * chunk;
+    if (lo >= rows) break;
+    int64_t hi = std::min(rows, lo + chunk);
+    pool.emplace_back(work, lo, hi);
+  }
+  work(0, std::min(rows, chunk));
+  for (auto& th : pool) th.join();
+}
+
+void producer_loop(Pipeline* p) {
+  for (;;) {
+    Buffer* buf = nullptr;
+    int64_t start, rows;
+    {
+      std::unique_lock<std::mutex> lk(p->mu);
+      if (p->shutdown || p->abort ||
+          p->cursor >= static_cast<int64_t>(p->perm.size())) {
+        p->epoch_active = false;
+        p->cv_ready.notify_all();
+        return;
+      }
+      p->cv_free.wait(lk, [p] {
+        return p->shutdown || p->abort || !p->free_bufs.empty();
+      });
+      if (p->shutdown || p->abort) {
+        p->epoch_active = false;
+        p->cv_ready.notify_all();
+        return;
+      }
+      buf = p->free_bufs.front();
+      p->free_bufs.pop_front();
+      start = p->cursor;
+      rows = std::min(p->batch, static_cast<int64_t>(p->perm.size()) - start);
+      p->cursor += rows;
+    }
+    gather_rows(*p, p->perm.data() + start, rows, buf->x.data(),
+                buf->y.data());
+    buf->rows = rows;
+    {
+      std::lock_guard<std::mutex> lk(p->mu);
+      p->ready.push_back(buf);
+    }
+    p->cv_ready.notify_one();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a pipeline over an in-memory dataset (pointers must stay valid for
+// the pipeline's lifetime — the Python wrapper keeps the arrays alive).
+void* dtp_create(const uint8_t* x, const int32_t* y, int64_t n,
+                 int64_t row_bytes, int64_t batch, int gather_threads,
+                 int prefetch_depth) {
+  if (x == nullptr || y == nullptr || n <= 0 || row_bytes <= 0 || batch <= 0)
+    return nullptr;
+  auto* p = new Pipeline();
+  p->x = x;
+  p->y = y;
+  p->n = n;
+  p->row_bytes = row_bytes;
+  p->batch = batch;
+  p->gather_threads = gather_threads < 1 ? 1 : gather_threads;
+  int depth = prefetch_depth < 1 ? 1 : prefetch_depth;
+  p->pool.resize(static_cast<size_t>(depth));
+  for (auto& b : p->pool) {
+    b.x.resize(static_cast<size_t>(batch * row_bytes));
+    b.y.resize(static_cast<size_t>(batch));
+    p->free_bufs.push_back(&b);
+  }
+  return p;
+}
+
+// Begin an epoch over `perm` (row indices into the dataset, length m ≤ n —
+// a shard passes only its own indices).  Restarts the producer thread.
+int64_t dtp_start_epoch(void* handle, const int64_t* perm, int64_t m) {
+  auto* p = static_cast<Pipeline*>(handle);
+  if (p == nullptr || perm == nullptr || m < 0) return -2;
+  if (p->producer.joinable()) {
+    {
+      std::lock_guard<std::mutex> lk(p->mu);
+      p->abort = true;
+    }
+    p->cv_free.notify_all();
+    p->producer.join();
+  }
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->abort = false;
+    for (int64_t i = 0; i < m; ++i)
+      if (perm[i] < 0 || perm[i] >= p->n) return -2;
+    p->perm.assign(perm, perm + m);
+    p->cursor = 0;
+    // recycle any batches left staged from an abandoned epoch
+    while (!p->ready.empty()) {
+      p->free_bufs.push_back(p->ready.front());
+      p->ready.pop_front();
+    }
+    p->epoch_active = m > 0;
+  }
+  if (m > 0) p->producer = std::thread(producer_loop, p);
+  return 0;
+}
+
+// Pop the next staged batch into caller buffers (out_x: batch*row_bytes,
+// out_y: batch int32).  Returns rows gathered (< batch only for the final
+// partial batch), 0 when the epoch is exhausted, -2 on bad handle.
+int64_t dtp_next(void* handle, uint8_t* out_x, int32_t* out_y) {
+  auto* p = static_cast<Pipeline*>(handle);
+  if (p == nullptr) return -2;
+  Buffer* buf = nullptr;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_ready.wait(lk, [p] {
+      return p->shutdown || !p->ready.empty() || !p->epoch_active;
+    });
+    if (p->shutdown) return 0;
+    if (p->ready.empty()) return 0;  // epoch done
+    buf = p->ready.front();
+    p->ready.pop_front();
+  }
+  std::memcpy(out_x, buf->x.data(), static_cast<size_t>(buf->rows * p->row_bytes));
+  std::memcpy(out_y, buf->y.data(), static_cast<size_t>(buf->rows) * sizeof(int32_t));
+  int64_t rows = buf->rows;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->free_bufs.push_back(buf);
+  }
+  p->cv_free.notify_one();
+  return rows;
+}
+
+void dtp_destroy(void* handle) { delete static_cast<Pipeline*>(handle); }
+
+}  // extern "C"

@@ -1,0 +1,190 @@
+// Native framed-socket transport (control plane).
+//
+// C++ rendering of the reference's wire layer
+// (/root/reference/centralized/network.py:4-28): every message is a 4-byte
+// big-endian length prefix followed by the payload, written/read with
+// blocking exactly-n semantics.  In the TPU framework tensors never travel
+// over sockets (XLA collectives own the data plane); this transport carries
+// the supervisor/benchmark channel and any reference-protocol peer, so it
+// stays byte-compatible with the reference's framing.
+//
+// Exported as a C ABI for ctypes.  All functions return negative values on
+// error; recv returns 0 payload length only for genuine zero-length frames
+// and DTW_CLOSED (-1) on orderly peer close, mirroring the Python recvall
+// contract (reference network.py:20-28 returns None on EOF).
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr int64_t DTW_CLOSED = -1;
+constexpr int64_t DTW_ERROR = -2;
+constexpr int64_t DTW_TOOBIG = -3;
+
+// Blocking write of exactly n bytes (EINTR-safe).
+int send_all(int fd, const uint8_t* buf, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t w = ::send(fd, buf + off, n - off, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    off += static_cast<size_t>(w);
+  }
+  return 0;
+}
+
+// Blocking read of exactly n bytes; 0 on success, DTW_CLOSED on EOF.
+int64_t recv_all(int fd, uint8_t* buf, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t r = ::recv(fd, buf + off, n - off, 0);
+    if (r == 0) return DTW_CLOSED;
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return DTW_ERROR;
+    }
+    off += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Send one frame: 4-byte big-endian length + payload.
+int64_t dtw_send_frame(int fd, const uint8_t* payload, uint32_t len) {
+  uint32_t be = htonl(len);
+  uint8_t header[4];
+  std::memcpy(header, &be, 4);
+  if (send_all(fd, header, 4) != 0) return DTW_ERROR;
+  if (len > 0 && send_all(fd, payload, len) != 0) return DTW_ERROR;
+  return 0;
+}
+
+// Receive one frame into out (capacity cap).  Returns payload length,
+// DTW_CLOSED on orderly close before/within the header, DTW_TOOBIG when the
+// frame exceeds cap (frame is consumed and discarded to keep the stream in
+// sync), DTW_ERROR otherwise.
+int64_t dtw_recv_frame(int fd, uint8_t* out, uint32_t cap) {
+  uint8_t header[4];
+  int64_t rc = recv_all(fd, header, 4);
+  if (rc != 0) return rc;
+  uint32_t be;
+  std::memcpy(&be, header, 4);
+  uint32_t len = ntohl(be);
+  if (len > cap) {
+    uint8_t sink[4096];
+    uint32_t left = len;
+    while (left > 0) {
+      uint32_t take = left < sizeof(sink) ? left : sizeof(sink);
+      rc = recv_all(fd, sink, take);
+      if (rc != 0) return rc;
+      left -= take;
+    }
+    return DTW_TOOBIG;
+  }
+  if (len > 0) {
+    rc = recv_all(fd, out, len);
+    if (rc != 0) return rc;
+  }
+  return static_cast<int64_t>(len);
+}
+
+// Peek the next frame's length without consuming it (for exact-size reads).
+// EINTR retries transparently (the Python path gets that via PEP 475); a
+// peer closing before a complete header is an orderly close (DTW_CLOSED),
+// matching recvall's None contract (reference network.py:20-28).
+int64_t dtw_peek_len(int fd) {
+  uint8_t header[4];
+  for (;;) {
+    ssize_t r = ::recv(fd, header, 4, MSG_PEEK | MSG_WAITALL);
+    if (r == 4) break;
+    if (r >= 0) return DTW_CLOSED;  // EOF with 0-3 header bytes
+    if (errno == EINTR) continue;
+    return DTW_ERROR;
+  }
+  uint32_t be;
+  std::memcpy(&be, header, 4);
+  return static_cast<int64_t>(ntohl(be));
+}
+
+// Connect to host:port (numeric or resolvable).  Returns fd or DTW_ERROR.
+int64_t dtw_connect(const char* host, int port) {
+  char portstr[16];
+  std::snprintf(portstr, sizeof(portstr), "%d", port);
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host, portstr, &hints, &res) != 0) return DTW_ERROR;
+  int fd = -1;
+  for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) return DTW_ERROR;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+// Listen on port (0 = ephemeral).  Returns listening fd or DTW_ERROR.
+int64_t dtw_listen(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return DTW_ERROR;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 64) != 0) {
+    ::close(fd);
+    return DTW_ERROR;
+  }
+  return fd;
+}
+
+// Bound port of a listening fd (for port=0 ephemeral binds).
+int64_t dtw_port(int fd) {
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) != 0)
+    return DTW_ERROR;
+  return ntohs(addr.sin_port);
+}
+
+// Accept one connection.  Returns connected fd or DTW_ERROR.
+int64_t dtw_accept(int listen_fd) {
+  for (;;) {
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd >= 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    if (errno != EINTR) return DTW_ERROR;
+  }
+}
+
+int64_t dtw_close(int fd) { return ::close(fd) == 0 ? 0 : DTW_ERROR; }
+
+}  // extern "C"

@@ -64,6 +64,10 @@ class ExperimentConfig:
     resume: bool = False                   # restore latest checkpoint first
     metrics_path: str | None = None        # per-step metrics JSONL
     profile_dir: str | None = None         # XLA profiler trace output
+    dtype: str = "float32"                 # model compute dtype; 'bfloat16'
+                                           # enables mixed precision (params
+                                           # stay f32, activations/matmuls
+                                           # run bf16 on the MXU)
 
 
 @dataclasses.dataclass
@@ -97,7 +101,8 @@ def _setup(config: ExperimentConfig) -> _Experiment:
     if config.model_fn is not None:
         model = config.model_fn()
     else:
-        model = modellib.create_model(config.model, num_classes=train_ds.num_classes)
+        model = modellib.create_model(config.model, num_classes=train_ds.num_classes,
+                                      dtype=config.dtype)
 
     # reference -b is the PER-WORKER batch (reference client.py:64 feeds each
     # worker's shard with batch_size b); global batch = b × n matches its
@@ -164,7 +169,7 @@ def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
     elif config.model in _SEQUENCE_MODELS:
         model = modellib.create_model(
             config.model, num_classes=train_ds.num_classes,
-            attention_impl=config.attention_impl)
+            attention_impl=config.attention_impl, dtype=config.dtype)
     else:
         raise ValueError(
             f"seq_parallel needs a sequence model ({'/'.join(_SEQUENCE_MODELS)}), "
@@ -188,7 +193,8 @@ def _setup_tensor_parallel(config: ExperimentConfig) -> _Experiment:
     if config.model_fn is not None:
         model = config.model_fn()
     elif config.model in ("mlp", "tp_mlp", "mnist_mlp"):
-        model = TPMLP(num_classes=train_ds.num_classes)
+        model = TPMLP(num_classes=train_ds.num_classes,
+                      dtype=modellib.resolve_dtype(config.dtype))
     else:
         raise ValueError(
             f"tensor_parallel currently ships TP annotations for the MLP "
@@ -222,7 +228,8 @@ def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
     engine = PipelineEngine(num_classes=train_ds.num_classes,
                             hidden=config.pipeline_hidden,
                             microbatches=config.microbatches, mesh=mesh,
-                            learning_rate=config.learning_rate)
+                            learning_rate=config.learning_rate,
+                            dtype=modellib.resolve_dtype(config.dtype))
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
 

@@ -15,6 +15,7 @@ pickle *encode* is provided for compatibility with reference-style listeners.
 
 from __future__ import annotations
 
+import ctypes
 import json
 import pickle
 import socket
@@ -24,11 +25,44 @@ from typing import Any
 _LEN = struct.Struct(">I")  # 4-byte big-endian length, reference network.py:6
 
 
+def _native_for(sock: socket.socket):
+    """Native transport lib, when usable for this socket.
+
+    Python sockets with a timeout set their fd non-blocking, which the C
+    blocking send/recv loops don't handle — those sockets stay on the
+    Python path.  The framing bytes are identical either way.
+    """
+    if sock.gettimeout() is not None:
+        return None
+    from distributed_tensorflow_tpu import native
+
+    return native.load()
+
+
 def send_bytes(sock: socket.socket, payload: bytes) -> None:
+    lib = _native_for(sock)
+    if lib is not None:
+        if lib.dtw_send_frame(sock.fileno(), payload, len(payload)) != 0:
+            raise ConnectionError("native send_frame failed")
+        return
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
 def recv_bytes(sock: socket.socket) -> bytes | None:
+    lib = _native_for(sock)
+    if lib is not None:
+        n = lib.dtw_peek_len(sock.fileno())
+        if n == -1:  # orderly close (DTW_CLOSED), reference recvall None
+            return None
+        if n < 0:
+            raise ConnectionError("native peek_len failed")
+        buf = ctypes.create_string_buffer(max(int(n), 1))
+        got = lib.dtw_recv_frame(sock.fileno(), buf, int(n))
+        if got == -1:
+            return None
+        if got < 0:
+            raise ConnectionError("native recv_frame failed")
+        return buf.raw[:got]
     header = recvall(sock, _LEN.size)
     if header is None:
         return None

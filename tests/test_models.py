@@ -68,6 +68,46 @@ def test_bert_tiny_forward():
     np.testing.assert_allclose(logits, lp, atol=1e-4)
 
 
+def test_bf16_mixed_precision():
+    """dtype='bfloat16' computes in bf16 but keeps f32 params and f32 logits
+    (mixed precision: MXU-rate matmuls, full-precision optimizer math)."""
+    from distributed_tensorflow_tpu.models import resolve_dtype
+
+    assert resolve_dtype("bf16") == jnp.bfloat16
+    assert resolve_dtype(jnp.float32) == jnp.float32
+    with pytest.raises(KeyError):
+        resolve_dtype("int4")
+
+    model = create_model("cnn", num_classes=10, dtype="bfloat16")
+    x = jnp.ones((2, 28, 28, 1))
+    params = model.init(jax.random.key(0), x, train=False)["params"]
+    assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(params))
+    logits = model.apply({"params": params}, x, train=False)
+    assert logits.dtype == jnp.float32
+
+    f32 = create_model("cnn", num_classes=10)
+    ref = f32.apply({"params": params}, x, train=False)
+    np.testing.assert_allclose(logits, ref, atol=0.15)  # bf16 has ~8 mantissa bits
+
+
+def test_bf16_training_learns(mesh8):
+    """A bf16 sync-DP step must still optimize (grads flow through casts)."""
+    from distributed_tensorflow_tpu.engines import SyncEngine
+
+    model = create_model("mlp", num_classes=10, dtype="bfloat16", hidden=32)
+    eng = SyncEngine(model, mesh=mesh8, learning_rate=1e-2)
+    rng = np.random.default_rng(0)
+    x = rng.random((64, 28, 28, 1), np.float32)
+    y = (np.arange(64) % 10).astype(np.int32)
+    state = eng.init_state(jax.random.key(0), x)
+    xs, ys = eng.shard_batch(x, y)
+    state, first = eng.step(state, xs, ys)  # step donates its input state
+    for _ in range(30):
+        state, m = eng.step(state, xs, ys)
+    assert float(m["loss"]) < float(first["loss"])
+    assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(state.params))
+
+
 def test_bert_flash_matches_dense():
     """attention_impl='flash' (Pallas kernel) must agree with 'dense'."""
     kw = dict(num_classes=2, vocab_size=100, max_len=32)
