@@ -93,6 +93,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(GPipe-style microbatched pipeline)")
     p.add_argument("--microbatches", type=int, default=4,
                    help="pipeline microbatches per step (bubble = (S-1)/(M+S-1))")
+    p.add_argument("-ep", "--expert-parallel", type=int, default=1,
+                   help="shard MoE experts over this many devices "
+                        "(GShard/Switch-style EP; --model moe)")
+    p.add_argument("--num-experts", type=int, default=8,
+                   help="MoE expert count (must divide by -ep)")
+    p.add_argument("--aux-weight", type=float, default=0.01,
+                   help="MoE load-balance auxiliary loss weight")
     p.add_argument("--result-path", default=None, help="JSONL event sink path")
     p.add_argument("--log-every", type=int, default=50)
     p.add_argument("--seed", type=int, default=0)
@@ -161,6 +168,9 @@ def main(argv: list[str] | None = None) -> dict:
         tensor_parallel=args.tensor_parallel,
         pipeline_parallel=args.pipeline_parallel,
         microbatches=args.microbatches,
+        expert_parallel=args.expert_parallel,
+        num_experts=args.num_experts,
+        aux_weight=args.aux_weight,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,

@@ -88,15 +88,20 @@ class Dataset:
 
     def _native_batcher(self, batch_size: int):
         """Cached per-batch-size native pipeline — reusing it across epochs
-        keeps one C++ thread pool + staging buffers (and, for sharded
-        datasets, one contiguous copy) alive for the whole run."""
+        keeps one C++ worker pool + staging buffers (and, for sharded
+        datasets, one contiguous copy) alive for the whole run.  If the
+        cached pipeline is mid-epoch (a concurrent iterator is active), a
+        fresh uncached one preserves the independent-iterators contract of
+        the Python path."""
+        from distributed_tensorflow_tpu.native.batcher import NativeBatcher
+
         cache = self.__dict__.setdefault("_batcher_cache", {})
         nb = cache.get(batch_size)
         if nb is None:
-            from distributed_tensorflow_tpu.native.batcher import NativeBatcher
-
             nb = NativeBatcher(self.x, self.y, batch_size)
             cache[batch_size] = nb
+        elif nb.busy:
+            nb = NativeBatcher(self.x, self.y, batch_size)
         return nb
 
 

@@ -26,6 +26,8 @@ from distributed_tensorflow_tpu.engines.seq_parallel import SeqParallelEngine  #
 from distributed_tensorflow_tpu.engines.tensor_parallel import (  # noqa: F401
     TensorParallelEngine, TPMLP)
 from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine  # noqa: F401
+from distributed_tensorflow_tpu.engines.expert_parallel import (  # noqa: F401
+    ExpertParallelEngine)
 
 ENGINES = {
     "sync": SyncEngine,

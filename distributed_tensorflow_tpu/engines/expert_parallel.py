@@ -1,0 +1,122 @@
+"""Expert parallelism via GSPMD auto-sharding.
+
+No reference counterpart (SURVEY.md §2.2: no MoE anywhere); TPU-native new
+capability.  Compiler-driven like the tensor-parallel engine: expert weights
+carry ``with_partitioning('expert', ...)`` annotations (models/moe.py), the
+batch is sharded over BOTH mesh axes (every device holds a token shard), and
+XLA GSPMD lowers the dispatch/combine einsums to the all-to-alls that carry
+token slots to their expert's device over ICI.
+
+Loss = task cross-entropy + ``aux_weight`` × the Switch load-balancing
+auxiliary loss the model sows into ``intermediates`` — without it top-1
+routing collapses onto a few experts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import flax.linen as nn
+
+from distributed_tensorflow_tpu.engines.base import (
+    Engine, TrainState, cross_entropy)
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+
+def _sum_aux(intermediates) -> jax.Array:
+    """Total of every sown aux_loss (one per MoE layer)."""
+    leaves = jax.tree.leaves(intermediates)
+    return sum(leaves, jnp.zeros((), jnp.float32))
+
+
+class ExpertParallelEngine(Engine):
+    """data × expert parallel sync training under one jit (GSPMD).
+
+    ``mesh`` must have axes ('data', 'expert'); tokens shard over the whole
+    mesh, stacked expert weights over 'expert' only (replicated over 'data').
+    """
+
+    def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
+                 aux_weight: float = 0.01):
+        if mesh is None or set(mesh.axis_names) != {meshlib.DATA_AXIS,
+                                                    meshlib.EXPERT_AXIS}:
+            raise ValueError(
+                "ExpertParallelEngine requires a ('data','expert') mesh")
+        self.aux_weight = aux_weight
+        super().__init__(model, optimizer, mesh, learning_rate)
+        # tokens shard over the WHOLE mesh (see shard_batch), so batch
+        # divisibility is against every device, not just the data axis
+        self.n_devices = (mesh.shape[meshlib.DATA_AXIS]
+                          * mesh.shape[meshlib.EXPERT_AXIS])
+
+    # every device holds a token shard: batch split over both mesh axes
+    def _batch_sharding(self, ndim: int) -> NamedSharding:
+        spec = P((meshlib.DATA_AXIS, meshlib.EXPERT_AXIS),
+                 *([None] * (ndim - 1)))
+        return NamedSharding(self.mesh, spec)
+
+    def shard_batch(self, x, y, mask=None):
+        xs = jax.device_put(x, self._batch_sharding(x.ndim))
+        ys = jax.device_put(y, self._batch_sharding(y.ndim))
+        if mask is None:
+            return xs, ys
+        ms = jax.device_put(mask, self._batch_sharding(mask.ndim))
+        return xs, ys, ms
+
+    def init_state(self, rng, sample_x) -> TrainState:
+        x = jnp.asarray(sample_x[:1])
+
+        def init_fn(rng):
+            params = self.model.init(rng, x, train=False)["params"]
+            opt_state = self.tx.init(params)
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=opt_state, rng=rng)
+
+        # read partitioning annotations, init already-sharded (as TP does)
+        abstract = jax.eval_shape(init_fn, rng)
+        specs = nn.get_partition_spec(abstract)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda s: isinstance(s, P))
+        return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+    def _build_step(self):
+        apply_fn = self.model.apply
+        tx, aux_weight = self.tx, self.aux_weight
+
+        def train_step(state: TrainState, x, y):
+            rng = jax.random.fold_in(state.rng, state.step)
+
+            def loss_fn(params):
+                logits, col = apply_fn(
+                    {"params": params}, x, train=True,
+                    rngs={"dropout": rng}, mutable=["intermediates"])
+                task = cross_entropy(logits, y).mean()
+                aux = _sum_aux(col["intermediates"])
+                acc = (logits.argmax(-1) == y).mean()
+                return task + aux_weight * aux, (task, acc)
+
+            (loss, (task, acc)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return state.replace(step=state.step + 1, params=params,
+                                 opt_state=opt_state), \
+                {"loss": task, "accuracy": acc, "total_loss": loss}
+
+        # jit semantics are global; GSPMD inserts the expert all-to-alls
+        return jax.jit(train_step, donate_argnums=0)
+
+    def _build_eval(self):
+        apply_fn = self.model.apply
+
+        def eval_step(params, x, y, mask):
+            logits = apply_fn({"params": params}, x, train=False)
+            correct = ((logits.argmax(-1) == y) * mask).sum()
+            loss_sum = (cross_entropy(logits, y) * mask).sum()
+            return correct, loss_sum, mask.sum()
+
+        return jax.jit(eval_step)

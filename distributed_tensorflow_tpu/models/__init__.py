@@ -81,8 +81,13 @@ def create_model(name: str, num_classes: int = 10, **kw) -> nn.Module:
         from distributed_tensorflow_tpu.models.bert import BertTinyClassifier
 
         return BertTinyClassifier(num_classes=num_classes, **kw)
+    if name in ("moe", "moe_mlp"):
+        from distributed_tensorflow_tpu.models.moe import MoEClassifier
+
+        return MoEClassifier(num_classes=num_classes, **kw)
     if name not in _REGISTRY:
-        raise KeyError(f"unknown model '{name}'; known: {sorted(_REGISTRY)} + resnet20, bert_tiny")
+        raise KeyError(f"unknown model '{name}'; known: {sorted(_REGISTRY)} "
+                       f"+ resnet20, bert_tiny, moe")
     return _REGISTRY[name](num_classes=num_classes, **kw)
 
 

@@ -1,0 +1,122 @@
+"""Mixture-of-Experts classifier with Switch-style top-1 routing.
+
+No reference counterpart (SURVEY.md §2.2: "EP (expert parallel): NO — no MoE
+anywhere"); this is TPU-native new capability completing the parallelism
+matrix (dp/tp/pp/sp/ep).
+
+TPU-first design — the GShard/Switch dense-dispatch formulation, which is
+what XLA partitions well:
+
+* Expert FFN weights are *stacked* with a leading expert dimension and
+  annotated ``with_partitioning`` on the ``expert`` mesh axis — each device
+  on that axis holds ``E / ep`` experts.
+* Routing is expressed as two einsums against a dispatch tensor
+  ``[tokens, E, capacity]`` (build: top-1 gate → capacity-limited position
+  via cumsum).  Static shapes throughout — capacity is computed at trace
+  time — so everything jits; under GSPMD the dispatch einsum lowers to the
+  all-to-all that moves token slots to their expert's device over ICI.
+* Router math (softmax, load-balance stats) runs in f32 regardless of the
+  model compute dtype (routing decisions are precision-sensitive).
+
+The Switch load-balancing auxiliary loss is sown into the
+``intermediates`` collection as ``aux_loss``; the expert-parallel engine
+adds ``aux_weight ×`` it to the task loss.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+
+class MoELayer(nn.Module):
+    """Top-1 routed expert FFN over tokens (leading axis of x).
+
+    ``partition_experts`` adds the ``with_partitioning('expert', ...)``
+    annotations the expert-parallel engine reads; leave False on meshes
+    without an 'expert' axis (plain DP) — the annotation names a mesh axis,
+    so it must only be present when that axis exists.
+    """
+
+    num_experts: int = 8
+    hidden: int = 256
+    capacity_factor: float = 1.25
+    partition_experts: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        tokens, d = x.shape
+        e = self.num_experts
+        capacity = max(1, int(self.capacity_factor * tokens / e + 0.999999))
+
+        # --- router (f32) ------------------------------------------------
+        gate_w = self.param("gate", nn.initializers.lecun_normal(), (d, e),
+                            jnp.float32)
+        probs = jax.nn.softmax(x.astype(jnp.float32) @ gate_w, axis=-1)
+        top1 = jnp.argmax(probs, axis=-1)                       # [T]
+        mask = jax.nn.one_hot(top1, e, dtype=jnp.float32)       # [T, E]
+
+        # Switch aux loss: E · Σ_e (token fraction · mean router prob)
+        aux = e * jnp.sum(mask.mean(axis=0) * probs.mean(axis=0))
+        self.sow("intermediates", "aux_loss", aux)
+
+        # --- capacity-limited dispatch/combine tensors -------------------
+        position = (jnp.cumsum(mask, axis=0) - 1.0) * mask      # [T, E]
+        keep = mask * (position < capacity)
+        pos_onehot = jax.nn.one_hot(position.astype(jnp.int32), capacity,
+                                    dtype=jnp.float32)          # [T, E, C]
+        dispatch = keep[:, :, None] * pos_onehot                # [T, E, C]
+        combine = dispatch * probs[:, :, None]                  # [T, E, C]
+
+        # --- expert FFN (stacked weights, expert axis sharded) -----------
+        init = nn.initializers.lecun_normal()
+        if self.partition_experts:
+            init = nn.with_partitioning(init, (meshlib.EXPERT_AXIS, None, None))
+        w1 = self.param("w1", init, (e, d, self.hidden), jnp.float32)
+        w2 = self.param("w2", init, (e, self.hidden, d), jnp.float32)
+
+        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(self.dtype),
+                               x.astype(self.dtype))
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in,
+                                   w1.astype(self.dtype)))
+        expert_out = jnp.einsum("ech,ehd->ecd", h, w2.astype(self.dtype))
+        y = jnp.einsum("tec,ecd->td", combine.astype(self.dtype), expert_out)
+        return y
+
+
+class MoEClassifier(nn.Module):
+    """embed → (residual MoE layer) × depth → head, over flattened inputs.
+
+    Plays the reference model_fn role (reference initializer.py:12-21) for
+    the expert-parallel mode: same (images → logits) contract as the MLP,
+    with the hidden FFN replaced by routed experts.
+    """
+
+    num_classes: int = 10
+    num_experts: int = 8
+    embed_dim: int = 128
+    expert_hidden: int = 256
+    depth: int = 1
+    capacity_factor: float = 1.25
+    dropout_rate: float = 0.1
+    partition_experts: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype).reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.embed_dim, dtype=self.dtype)(x))
+        for _ in range(self.depth):
+            y = MoELayer(num_experts=self.num_experts,
+                         hidden=self.expert_hidden,
+                         capacity_factor=self.capacity_factor,
+                         partition_experts=self.partition_experts,
+                         dtype=self.dtype)(x)
+            x = x + y  # residual: dropped (over-capacity) tokens pass through
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -94,8 +94,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dtw_send_frame.restype = c.c_int64
     lib.dtw_recv_frame.argtypes = [c.c_int, c.c_void_p, c.c_uint32]
     lib.dtw_recv_frame.restype = c.c_int64
-    lib.dtw_peek_len.argtypes = [c.c_int]
-    lib.dtw_peek_len.restype = c.c_int64
+    lib.dtw_recv_header.argtypes = [c.c_int]
+    lib.dtw_recv_header.restype = c.c_int64
+    lib.dtw_recv_body.argtypes = [c.c_int, c.c_void_p, c.c_uint32]
+    lib.dtw_recv_body.restype = c.c_int64
     lib.dtw_connect.argtypes = [c.c_char_p, c.c_int]
     lib.dtw_connect.restype = c.c_int64
     lib.dtw_listen.argtypes = [c.c_int]

@@ -53,10 +53,36 @@ class NativeBatcher:
         if not self._handle:
             raise RuntimeError("dtp_create failed")
         self._full_mask = np.ones(batch_size, np.float32)
+        self.busy = False  # an epoch iterator currently owns the C++ handle
 
     def epoch(self, *, shuffle: bool = True, seed: int = 0, epoch: int = 0,
               drop_remainder: bool = False) -> Iterator[Batch]:
-        """Yield (x, y, mask) batches for one epoch — the iter_batches contract."""
+        """Yield (x, y, mask) batches for one epoch — the iter_batches contract.
+
+        One iterator at a time: the C++ handle holds a single epoch's
+        cursor, so a second concurrent iterator would hijack it.  ``busy``
+        is claimed eagerly here (not at first next()) and released when the
+        iterator is exhausted, closed, or garbage-collected; callers that
+        need concurrency create another NativeBatcher (Dataset.batches does
+        this automatically).
+        """
+        if self.busy:
+            raise RuntimeError(
+                "NativeBatcher is busy: another epoch iterator is active; "
+                "create a separate NativeBatcher for concurrent iteration")
+        self.busy = True
+        return self._epoch_gen(shuffle=shuffle, seed=seed, epoch=epoch,
+                               drop_remainder=drop_remainder)
+
+    def _epoch_gen(self, *, shuffle, seed, epoch, drop_remainder):
+        try:
+            yield from self._epoch_body(shuffle=shuffle, seed=seed,
+                                        epoch=epoch,
+                                        drop_remainder=drop_remainder)
+        finally:
+            self.busy = False
+
+    def _epoch_body(self, *, shuffle, seed, epoch, drop_remainder):
         n = len(self._x)
         idx = np.arange(n, dtype=np.int64)
         if shuffle:

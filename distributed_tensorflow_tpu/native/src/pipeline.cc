@@ -58,6 +58,22 @@ struct Pipeline {
   bool abort = false;           // unblock+exit producer (epoch restart)
   bool shutdown = false;
 
+  // persistent gather worker pool (threads live for the pipeline's
+  // lifetime; the producer submits one gather task per batch and also
+  // works on it itself — no per-batch thread create/join)
+  std::vector<std::thread> workers;
+  std::mutex task_mu;
+  std::condition_variable cv_task;
+  std::condition_variable cv_task_done;
+  const int64_t* task_idx = nullptr;
+  uint8_t* task_out_x = nullptr;
+  int32_t* task_out_y = nullptr;
+  int64_t task_rows = 0;
+  std::atomic<int64_t> task_next{0};
+  int task_pending = 0;         // workers still to finish the current task
+  uint64_t task_seq = 0;        // bumped per submitted task
+  bool workers_shutdown = false;
+
   ~Pipeline() {
     {
       std::lock_guard<std::mutex> lk(mu);
@@ -66,39 +82,78 @@ struct Pipeline {
     cv_free.notify_all();
     cv_ready.notify_all();
     if (producer.joinable()) producer.join();
+    {
+      std::lock_guard<std::mutex> lk(task_mu);
+      workers_shutdown = true;
+    }
+    cv_task.notify_all();
+    for (auto& w : workers) w.join();
   }
 };
 
-// Parallel row gather: out[i] = x[idx[i]] for i in [0, rows).
-void gather_rows(const Pipeline& p, const int64_t* idx, int64_t rows,
+// Rows per work-stealing grab: big enough to amortize the atomic, small
+// enough to balance across workers.
+constexpr int64_t kGatherChunk = 64;
+
+void gather_chunks(Pipeline* p) {
+  for (;;) {
+    int64_t lo = p->task_next.fetch_add(kGatherChunk);
+    if (lo >= p->task_rows) return;
+    int64_t hi = std::min(p->task_rows, lo + kGatherChunk);
+    for (int64_t i = lo; i < hi; ++i) {
+      std::memcpy(p->task_out_x + i * p->row_bytes,
+                  p->x + p->task_idx[i] * p->row_bytes,
+                  static_cast<size_t>(p->row_bytes));
+      p->task_out_y[i] = p->y[p->task_idx[i]];
+    }
+  }
+}
+
+void gather_worker_loop(Pipeline* p) {
+  uint64_t seen = 0;
+  for (;;) {
+    {
+      std::unique_lock<std::mutex> lk(p->task_mu);
+      p->cv_task.wait(lk, [p, seen] {
+        return p->workers_shutdown || p->task_seq != seen;
+      });
+      if (p->workers_shutdown) return;
+      seen = p->task_seq;
+    }
+    gather_chunks(p);
+    {
+      std::lock_guard<std::mutex> lk(p->task_mu);
+      if (--p->task_pending == 0) p->cv_task_done.notify_one();
+    }
+  }
+}
+
+// Parallel row gather: out[i] = x[idx[i]] for i in [0, rows).  Called only
+// from the producer thread (single submitter by construction).
+void gather_rows(Pipeline* p, const int64_t* idx, int64_t rows,
                  uint8_t* out_x, int32_t* out_y) {
-  int threads = p.gather_threads;
-  if (threads <= 1 || rows < 2 * threads) {
+  if (p->workers.empty() || rows < 2 * kGatherChunk) {
     for (int64_t i = 0; i < rows; ++i) {
-      std::memcpy(out_x + i * p.row_bytes, p.x + idx[i] * p.row_bytes,
-                  static_cast<size_t>(p.row_bytes));
-      out_y[i] = p.y[idx[i]];
+      std::memcpy(out_x + i * p->row_bytes, p->x + idx[i] * p->row_bytes,
+                  static_cast<size_t>(p->row_bytes));
+      out_y[i] = p->y[idx[i]];
     }
     return;
   }
-  std::vector<std::thread> pool;
-  pool.reserve(static_cast<size_t>(threads) - 1);
-  int64_t chunk = (rows + threads - 1) / threads;
-  auto work = [&](int64_t lo, int64_t hi) {
-    for (int64_t i = lo; i < hi; ++i) {
-      std::memcpy(out_x + i * p.row_bytes, p.x + idx[i] * p.row_bytes,
-                  static_cast<size_t>(p.row_bytes));
-      out_y[i] = p.y[idx[i]];
-    }
-  };
-  for (int t = 1; t < threads; ++t) {
-    int64_t lo = t * chunk;
-    if (lo >= rows) break;
-    int64_t hi = std::min(rows, lo + chunk);
-    pool.emplace_back(work, lo, hi);
+  {
+    std::lock_guard<std::mutex> lk(p->task_mu);
+    p->task_idx = idx;
+    p->task_out_x = out_x;
+    p->task_out_y = out_y;
+    p->task_rows = rows;
+    p->task_next.store(0);
+    p->task_pending = static_cast<int>(p->workers.size());
+    ++p->task_seq;
   }
-  work(0, std::min(rows, chunk));
-  for (auto& th : pool) th.join();
+  p->cv_task.notify_all();
+  gather_chunks(p);  // the producer pulls chunks too
+  std::unique_lock<std::mutex> lk(p->task_mu);
+  p->cv_task_done.wait(lk, [p] { return p->task_pending == 0; });
 }
 
 void producer_loop(Pipeline* p) {
@@ -127,7 +182,7 @@ void producer_loop(Pipeline* p) {
       rows = std::min(p->batch, static_cast<int64_t>(p->perm.size()) - start);
       p->cursor += rows;
     }
-    gather_rows(*p, p->perm.data() + start, rows, buf->x.data(),
+    gather_rows(p, p->perm.data() + start, rows, buf->x.data(),
                 buf->y.data());
     buf->rows = rows;
     {
@@ -163,6 +218,9 @@ void* dtp_create(const uint8_t* x, const int32_t* y, int64_t n,
     b.y.resize(static_cast<size_t>(batch));
     p->free_bufs.push_back(&b);
   }
+  // persistent gather workers (producer participates, so spawn one fewer)
+  for (int t = 1; t < p->gather_threads; ++t)
+    p->workers.emplace_back(gather_worker_loop, p);
   return p;
 }
 

@@ -103,22 +103,24 @@ int64_t dtw_recv_frame(int fd, uint8_t* out, uint32_t cap) {
   return static_cast<int64_t>(len);
 }
 
-// Peek the next frame's length without consuming it (for exact-size reads).
-// EINTR retries transparently (the Python path gets that via PEP 475); a
-// peer closing before a complete header is an orderly close (DTW_CLOSED),
-// matching recvall's None contract (reference network.py:20-28).
-int64_t dtw_peek_len(int fd) {
+// Consume the next frame's 4-byte header and return the payload length
+// (for exact-size allocation before dtw_recv_body).  recv_all loops over
+// partial reads and retries EINTR, so a signal or a header straddling TCP
+// segments can't be misread; a peer closing before a complete header is an
+// orderly close (DTW_CLOSED), matching recvall's None contract (reference
+// network.py:20-28).
+int64_t dtw_recv_header(int fd) {
   uint8_t header[4];
-  for (;;) {
-    ssize_t r = ::recv(fd, header, 4, MSG_PEEK | MSG_WAITALL);
-    if (r == 4) break;
-    if (r >= 0) return DTW_CLOSED;  // EOF with 0-3 header bytes
-    if (errno == EINTR) continue;
-    return DTW_ERROR;
-  }
+  int64_t rc = recv_all(fd, header, 4);
+  if (rc != 0) return rc;
   uint32_t be;
   std::memcpy(&be, header, 4);
   return static_cast<int64_t>(ntohl(be));
+}
+
+// Read exactly len payload bytes following dtw_recv_header.  0 on success.
+int64_t dtw_recv_body(int fd, uint8_t* out, uint32_t len) {
+  return recv_all(fd, out, len);
 }
 
 // Connect to host:port (numeric or resolvable).  Returns fd or DTW_ERROR.

@@ -24,6 +24,7 @@ DATA_AXIS = "data"      # data parallelism (the reference's only axis)
 MODEL_AXIS = "model"    # tensor parallelism
 SEQ_AXIS = "seq"        # sequence/context parallelism (ring attention)
 PIPE_AXIS = "pipe"      # pipeline parallelism
+EXPERT_AXIS = "expert"  # expert parallelism (MoE)
 
 
 def device_count() -> int:

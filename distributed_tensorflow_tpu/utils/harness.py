@@ -58,6 +58,10 @@ class ExperimentConfig:
     pipeline_parallel: int = 1             # >1: shard stages over a 'pipe'
                                            # mesh axis (GPipe microbatching)
     microbatches: int = 4                  # pipeline microbatches per step
+    expert_parallel: int = 1               # >1: shard MoE experts over an
+                                           # 'expert' mesh axis
+    num_experts: int = 8                   # MoE expert count
+    aux_weight: float = 0.01               # MoE load-balance loss weight
     pipeline_hidden: int = 128             # pipeline stage width
     checkpoint_dir: str | None = None      # enable TrainState checkpointing
     checkpoint_every: int = 0              # steps between checkpoints (0=end only)
@@ -83,7 +87,8 @@ class _Experiment:
 
 
 def _setup(config: ExperimentConfig) -> _Experiment:
-    multi = [f for f in ("seq_parallel", "tensor_parallel", "pipeline_parallel")
+    multi = [f for f in ("seq_parallel", "tensor_parallel", "pipeline_parallel",
+                         "expert_parallel")
              if getattr(config, f) > 1]
     if len(multi) > 1:
         raise ValueError(f"{' and '.join(multi)} are mutually exclusive in "
@@ -94,6 +99,8 @@ def _setup(config: ExperimentConfig) -> _Experiment:
         return _setup_tensor_parallel(config)
     if config.pipeline_parallel > 1:
         return _setup_pipeline_parallel(config)
+    if config.expert_parallel > 1:
+        return _setup_expert_parallel(config)
     mesh = meshlib.create_mesh(config.n_devices)
     n = mesh.shape[meshlib.DATA_AXIS]
 
@@ -234,6 +241,43 @@ def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
                        engine=engine, global_batch=_global_batch(config, dp))
 
 
+def _setup_expert_parallel(config: ExperimentConfig) -> _Experiment:
+    """MoE mode: 2-D (data, expert) mesh; experts shard over 'expert',
+    tokens over the whole mesh (engines/expert_parallel.py)."""
+    from distributed_tensorflow_tpu.engines.expert_parallel import (
+        ExpertParallelEngine)
+
+    mesh, dp = _split_mesh(config, config.expert_parallel, "expert_parallel",
+                           meshlib.EXPERT_AXIS)
+    train_ds, test_ds = _load_data(config)
+    if config.model_fn is not None:
+        model = config.model_fn()
+    elif config.model in ("moe", "moe_mlp", "mlp"):
+        if config.num_experts % config.expert_parallel:
+            raise ValueError(
+                f"num_experts {config.num_experts} not divisible by "
+                f"expert_parallel {config.expert_parallel}")
+        model = modellib.create_model(
+            "moe", num_classes=train_ds.num_classes,
+            num_experts=config.num_experts, partition_experts=True,
+            dtype=config.dtype)
+    else:
+        raise ValueError(
+            f"expert_parallel needs the MoE model (got --model "
+            f"{config.model}); pass model_fn for a custom MoE with "
+            f"with_partitioning('expert', ...) annotations")
+
+    engine = ExpertParallelEngine(model, mesh=mesh,
+                                  learning_rate=config.learning_rate,
+                                  aux_weight=config.aux_weight)
+    # the full mesh holds token shards, so the global batch scales with every
+    # device, not just the data axis
+    n_total = dp * config.expert_parallel
+    return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
+                       engine=engine,
+                       global_batch=_global_batch(config, n_total))
+
+
 def run(config: ExperimentConfig) -> dict[str, Any]:
     """Run one experiment; returns the summary dict (also emitted as JSONL)."""
     ex = _setup(config)
@@ -292,10 +336,12 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         engine_name = "tensor_parallel"
     elif config.pipeline_parallel > 1:
         engine_name = "pipeline_parallel"
+    elif config.expert_parallel > 1:
+        engine_name = "expert_parallel"
     else:
         engine_name = config.engine
     total_devices = (n * config.seq_parallel * config.tensor_parallel
-                     * config.pipeline_parallel)
+                     * config.pipeline_parallel * config.expert_parallel)
     summary = {
         "engine": engine_name,
         "model": config.model,
@@ -306,6 +352,9 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         "seq_parallel": config.seq_parallel,
         "tensor_parallel": config.tensor_parallel,
         "pipeline_parallel": config.pipeline_parallel,
+        "expert_parallel": config.expert_parallel,
+        "num_experts": (config.num_experts
+                        if config.expert_parallel > 1 else None),
         "microbatches": (config.microbatches
                          if config.pipeline_parallel > 1 else None),
         "global_batch": global_batch,

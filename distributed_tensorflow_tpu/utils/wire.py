@@ -51,18 +51,18 @@ def send_bytes(sock: socket.socket, payload: bytes) -> None:
 def recv_bytes(sock: socket.socket) -> bytes | None:
     lib = _native_for(sock)
     if lib is not None:
-        n = lib.dtw_peek_len(sock.fileno())
+        n = lib.dtw_recv_header(sock.fileno())
         if n == -1:  # orderly close (DTW_CLOSED), reference recvall None
             return None
         if n < 0:
-            raise ConnectionError("native peek_len failed")
+            raise ConnectionError("native recv_header failed")
         buf = ctypes.create_string_buffer(max(int(n), 1))
-        got = lib.dtw_recv_frame(sock.fileno(), buf, int(n))
-        if got == -1:
+        rc = lib.dtw_recv_body(sock.fileno(), buf, int(n))
+        if rc == -1:  # closed mid-payload
             return None
-        if got < 0:
-            raise ConnectionError("native recv_frame failed")
-        return buf.raw[:got]
+        if rc < 0:
+            raise ConnectionError("native recv_body failed")
+        return buf.raw[: int(n)]
     header = recvall(sock, _LEN.size)
     if header is None:
         return None

@@ -159,6 +159,9 @@ def test_pipeline_epoch_restart_and_reuse():
     nb = NativeBatcher(x, y, 8, prefetch_depth=2)
     it = nb.epoch(shuffle=True, seed=1, epoch=0)
     next(it)  # consume one batch, abandon the rest while producer is staged
+    with pytest.raises(RuntimeError):
+        nb.epoch()  # handle is busy while the first iterator is live
+    it.close()  # releases the handle
     full = list(nb.epoch(shuffle=True, seed=1, epoch=1))
     ref = _ref_batches(x, y, 8, shuffle=True, seed=1, epoch=1)
     assert len(full) == len(ref)
@@ -166,6 +169,29 @@ def test_pipeline_epoch_restart_and_reuse():
         np.testing.assert_array_equal(rx, gx)
         np.testing.assert_array_equal(ry, gy)
     nb.close()
+
+
+def test_dataset_concurrent_iterators_independent():
+    """Two live Dataset.batches() iterators must not corrupt each other
+    (each gets its own native pipeline when the cached one is busy)."""
+    from distributed_tensorflow_tpu.data.loaders import Dataset
+
+    x = np.arange(4 * 64, dtype=np.float32).reshape(64, 4)
+    y = np.arange(64, dtype=np.int32)
+    ds = Dataset(x=x, y=y, num_classes=10)
+    it1 = ds.batches(8, shuffle=True, seed=5, epoch=0, native=True)
+    it2 = ds.batches(8, shuffle=True, seed=5, epoch=1, native=True)
+    got1, got2 = [], []
+    for a, b in zip(it1, it2):  # interleave consumption
+        got1.append(a)
+        got2.append(b)
+    ref1 = _ref_batches(x, y, 8, shuffle=True, seed=5, epoch=0)
+    ref2 = _ref_batches(x, y, 8, shuffle=True, seed=5, epoch=1)
+    for ref, got in ((ref1, got1), (ref2, got2)):
+        assert len(ref) == len(got)
+        for (rx, ry, rm), (gx, gy, gm) in zip(ref, got):
+            np.testing.assert_array_equal(rx, gx)
+            np.testing.assert_array_equal(ry, gy)
 
 
 def test_dataset_batches_native_parity():
