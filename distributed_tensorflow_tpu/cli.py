@@ -117,6 +117,15 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["float32", "f32", "bfloat16", "bf16"],
                    help="model compute dtype; bfloat16 = mixed precision "
                         "(f32 params, bf16 activations on the MXU)")
+    p.add_argument("--watchdog-timeout", type=float, default=0.0,
+                   help=">0: raise StallDetected if no step completes within "
+                        "this many seconds (the reference deadlocks instead)")
+    p.add_argument("--no-nan-guard", action="store_true",
+                   help="disable the divergence (NaN/inf loss) check")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help=">0: on crash, restart from the latest checkpoint up "
+                        "to N times (requires --checkpoint-dir + "
+                        "--checkpoint-every)")
     return p
 
 
@@ -177,8 +186,16 @@ def main(argv: list[str] | None = None) -> dict:
         metrics_path=args.metrics_path,
         profile_dir=args.profile_dir,
         dtype=args.dtype,
+        watchdog_timeout=args.watchdog_timeout,
+        nan_guard=not args.no_nan_guard,
+        max_restarts=args.max_restarts,
     )
-    summary = run(config)
+    if args.max_restarts > 0:
+        from distributed_tensorflow_tpu.utils.failure import run_with_recovery
+
+        summary = run_with_recovery(config, max_restarts=args.max_restarts)
+    else:
+        summary = run(config)
     print(json.dumps(summary))
     return summary
 

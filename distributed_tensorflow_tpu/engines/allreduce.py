@@ -38,13 +38,20 @@ class Trainer:
     def fit(self, train_ds, epochs: int = 1, batch_size: int | None = None,
             log_every: int = 50, log_fn: Callable[[str], None] = print,
             checkpoint_manager=None, checkpoint_every: int = 0,
-            metrics_logger=None) -> dict:
+            metrics_logger=None, watchdog=None, nan_guard: bool = True) -> dict:
         """Train; returns {'elapsed': seconds_around_fit, 'steps': n, ...} —
         the reference's only training metrics (reference dist_keras.py:41-49).
 
         ``checkpoint_manager``/``checkpoint_every``: periodic TrainState
         checkpoints (+ one final); ``metrics_logger``: per-step JSONL sink.
+        ``watchdog``: a utils.failure.Watchdog — beaten once per loop
+        iteration (the throttle keeps the loop within max_in_flight of
+        device progress, so a hung device stops the beats within that
+        window and the watchdog's on_stall callback fires).
+        ``nan_guard``: divergence check on metrics already materialized at
+        the logging cadence (no extra device syncs; utils/failure.py).
         """
+        from distributed_tensorflow_tpu.utils.failure import check_finite
         eng = self.engine
         bs = batch_size or train_ds.batch_size or 32
         bs = max(bs, eng.n_devices)
@@ -68,6 +75,9 @@ class Trainer:
             for bx, by, _ in train_ds.batches(
                     bs, shuffle=True, seed=self.seed, epoch=epoch,
                     drop_remainder=True):
+                if watchdog is not None:
+                    watchdog.beat()  # loop liveness: throttling bounds how
+                    # far this can run ahead of actual device progress
                 with timer:  # amortized dispatch+throttle time (see result)
                     xs, ys = self.engine.shard_batch(bx, by)
                     self.state, metrics = eng.step(self.state, xs, ys)
@@ -81,18 +91,26 @@ class Trainer:
                     # throttle-check BEFORE float(): forcing device values
                     # every step would sync the host into the pipeline that
                     # max_in_flight deliberately keeps async
-                    metrics_logger.log(gstep,
-                                       **{k: float(v) for k, v in metrics.items()})
+                    floats = {k: float(v) for k, v in metrics.items()}
+                    if nan_guard:
+                        check_finite(floats, gstep)
+                    metrics_logger.log(gstep, **floats)
                 if checkpoint_manager is not None and checkpoint_every and \
                         gstep % checkpoint_every == 0:
                     jax.block_until_ready(self.state)
                     checkpoint_manager.save(self.state)
                 if log_every and steps % log_every == 0:
                     m = {k: float(v) for k, v in metrics.items()}
+                    if nan_guard:
+                        check_finite(m, gstep)
                     last_metrics = m
                     # progress heartbeat — parity with reference client.py:92-94
                     log_fn(f"step {gstep}  loss {m['loss']:.4f}  acc {m['accuracy']:.4f}")
         jax.block_until_ready(self.state)
+        if nan_guard and steps:
+            final = {k: float(v) for k, v in metrics.items()}
+            check_finite(final, start_step + steps)
+            last_metrics = last_metrics or final
         elapsed = time.perf_counter() - t0
         if checkpoint_manager is not None:
             checkpoint_manager.save(self.state)

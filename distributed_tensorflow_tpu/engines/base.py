@@ -154,3 +154,26 @@ class Engine:
     def _per_device_rng(self, state_rng: jax.Array, step: jax.Array) -> jax.Array:
         rng = jax.random.fold_in(state_rng, step)
         return jax.random.fold_in(rng, coll.axis_index(self.axis))
+
+    def _init_partitioned_state(self, rng: jax.Array, sample_x) -> TrainState:
+        """Sharded init for GSPMD engines: abstract-eval the init to read
+        the model's `with_partitioning` annotations, then jit-init with
+        those shardings so large params materialize already sharded (never
+        replicated-then-resharded).  Unannotated params replicate."""
+        import flax.linen as nn
+        from jax.sharding import NamedSharding
+
+        x = jnp.asarray(sample_x[:1])
+
+        def init_fn(rng):
+            params = self.model.init(rng, x, train=False)["params"]
+            opt_state = self.tx.init(params)
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=opt_state, rng=rng)
+
+        abstract = jax.eval_shape(init_fn, rng)
+        specs = nn.get_partition_spec(abstract)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda s: isinstance(s, P))
+        return jax.jit(init_fn, out_shardings=shardings)(rng)

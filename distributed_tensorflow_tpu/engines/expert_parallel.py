@@ -19,8 +19,6 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-import flax.linen as nn
-
 from distributed_tensorflow_tpu.engines.base import (
     Engine, TrainState, cross_entropy)
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
@@ -67,21 +65,7 @@ class ExpertParallelEngine(Engine):
         return xs, ys, ms
 
     def init_state(self, rng, sample_x) -> TrainState:
-        x = jnp.asarray(sample_x[:1])
-
-        def init_fn(rng):
-            params = self.model.init(rng, x, train=False)["params"]
-            opt_state = self.tx.init(params)
-            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                              opt_state=opt_state, rng=rng)
-
-        # read partitioning annotations, init already-sharded (as TP does)
-        abstract = jax.eval_shape(init_fn, rng)
-        specs = nn.get_partition_spec(abstract)
-        shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), specs,
-            is_leaf=lambda s: isinstance(s, P))
-        return jax.jit(init_fn, out_shardings=shardings)(rng)
+        return self._init_partitioned_state(rng, sample_x)
 
     def _build_step(self):
         apply_fn = self.model.apply

@@ -20,7 +20,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 import flax.linen as nn
 
@@ -80,24 +79,7 @@ class TensorParallelEngine(Engine):
         super().__init__(model, optimizer, mesh, learning_rate)
 
     def init_state(self, rng, sample_x) -> TrainState:
-        x = jnp.asarray(sample_x[:1])
-
-        def init_fn(rng):
-            variables = self.model.init(rng, x, train=False)
-            params = variables["params"]
-            opt_state = self.tx.init(params)
-            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                              opt_state=opt_state, rng=rng)
-
-        # abstract-eval to read the partitioning annotations, then jit-init
-        # with those shardings so large params materialize already sharded
-        abstract = jax.eval_shape(init_fn, rng)
-        specs = nn.get_partition_spec(abstract)
-        shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, P))
-        state = jax.jit(init_fn, out_shardings=shardings)(rng)
-        return state
+        return self._init_partitioned_state(rng, sample_x)
 
     def _build_step(self):
         apply_fn = self.model.apply

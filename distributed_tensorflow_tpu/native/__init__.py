@@ -37,7 +37,8 @@ def _lib_path() -> Path:
 def build(force: bool = False) -> Path | None:
     """Compile src/*.cc into the package-local _build/ dir; None on failure."""
     out = _lib_path()
-    sources = sorted(_SRC_DIR.glob("*.cc"))
+    sources = [s for s in sorted(_SRC_DIR.glob("*.cc"))
+               if not s.stem.endswith("_test")]
     if not sources:
         return None
     if out.exists() and not force:
@@ -59,6 +60,32 @@ def build(force: bool = False) -> Path | None:
         tmp_path.unlink(missing_ok=True)
         return None
     tmp_path.replace(out)
+    return out
+
+
+def build_race_test() -> Path | None:
+    """Build the ThreadSanitizer driver over pipeline.cc (race detection for
+    the native runtime — a capability the reference lacks outright,
+    SURVEY.md §5).  Returns the binary path, or None when the toolchain or
+    libtsan is unavailable.  Run it; any 'WARNING: ThreadSanitizer' output
+    (exit code 66 under default TSAN options) is a detected race.
+    """
+    out = Path(__file__).parent / "_build" / "pipeline_tsan_test"
+    sources = [_SRC_DIR / "pipeline.cc", _SRC_DIR / "pipeline_tsan_test.cc"]
+    if not all(s.exists() for s in sources):
+        return None
+    if out.exists() and out.stat().st_mtime >= max(
+            s.stat().st_mtime for s in sources):
+        return out
+    out.parent.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        os.environ.get("CXX", "g++"), "-O1", "-g", "-std=c++17", "-pthread",
+        "-fsanitize=thread", *map(str, sources), "-o", str(out),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+    except (OSError, subprocess.SubprocessError):
+        return None
     return out
 
 

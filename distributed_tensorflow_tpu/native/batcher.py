@@ -22,6 +22,38 @@ from distributed_tensorflow_tpu import native
 Batch = tuple[np.ndarray, np.ndarray, np.ndarray]
 
 
+class _EpochIterator:
+    """Iterator over one epoch that owns the batcher's busy claim.
+
+    Releases the claim on exhaustion, close(), or garbage collection — even
+    if iteration never started (a plain generator's try/finally would not
+    run for an unstarted generator, leaking the claim forever).
+    """
+
+    def __init__(self, batcher: "NativeBatcher", gen):
+        self._batcher = batcher
+        self._gen = gen
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Batch:
+        try:
+            return next(self._gen)
+        except BaseException:
+            self.close()
+            raise
+
+    def close(self) -> None:
+        if self._batcher is not None:
+            self._gen.close()
+            self._batcher.busy = False
+            self._batcher = None
+
+    def __del__(self):
+        self.close()
+
+
 class NativeBatcher:
     """Reusable pipeline over one in-memory dataset.
 
@@ -62,25 +94,19 @@ class NativeBatcher:
         One iterator at a time: the C++ handle holds a single epoch's
         cursor, so a second concurrent iterator would hijack it.  ``busy``
         is claimed eagerly here (not at first next()) and released when the
-        iterator is exhausted, closed, or garbage-collected; callers that
-        need concurrency create another NativeBatcher (Dataset.batches does
-        this automatically).
+        returned iterator is exhausted, closed, or garbage-collected —
+        including before its first next() (_EpochIterator owns the claim);
+        callers that need concurrency create another NativeBatcher
+        (Dataset.batches does this automatically).
         """
         if self.busy:
             raise RuntimeError(
                 "NativeBatcher is busy: another epoch iterator is active; "
                 "create a separate NativeBatcher for concurrent iteration")
         self.busy = True
-        return self._epoch_gen(shuffle=shuffle, seed=seed, epoch=epoch,
-                               drop_remainder=drop_remainder)
-
-    def _epoch_gen(self, *, shuffle, seed, epoch, drop_remainder):
-        try:
-            yield from self._epoch_body(shuffle=shuffle, seed=seed,
-                                        epoch=epoch,
-                                        drop_remainder=drop_remainder)
-        finally:
-            self.busy = False
+        return _EpochIterator(self, self._epoch_body(
+            shuffle=shuffle, seed=seed, epoch=epoch,
+            drop_remainder=drop_remainder))
 
     def _epoch_body(self, *, shuffle, seed, epoch, drop_remainder):
         n = len(self._x)

@@ -1,0 +1,161 @@
+"""Failure detection and crash recovery.
+
+The reference has NO failure handling — a dead worker deadlocks the rest at
+the fixed-size barrier and the server thread spins forever on a closed
+connection (reference server.py:132-134, 151; SURVEY.md §5 "Failure
+detection: NO (and buggy)").  The only resilience is the client's
+connect-retry loop (reference client.py:56-62).
+
+TPU-native failure handling is different in kind: there are no per-worker
+sockets to watch — a training process is a single SPMD program, so the
+failure modes are (a) the numeric kind, a diverged/NaN loss; (b) the stall
+kind, a step that never completes (hung collective, wedged runtime); and
+(c) the crash kind, the process dying.  This module covers all three:
+
+  check_finite   — divergence detection on materialized metrics
+  Watchdog       — wall-clock stall detector around the step loop
+  run_with_recovery — restart-from-latest-checkpoint crash recovery loop
+                   (pairs with utils/checkpoint.py, the durable-state story
+                   the reference lacks entirely)
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Callable
+
+
+class TrainingDiverged(RuntimeError):
+    """Loss/metric became NaN or infinite."""
+
+
+class StallDetected(RuntimeError):
+    """No step completed within the watchdog timeout."""
+
+
+def check_finite(metrics: dict[str, float], step: int | None = None) -> None:
+    """Raise TrainingDiverged if any materialized metric is NaN/inf.
+
+    Call sites pass metrics that are already host floats (the Trainer only
+    materializes on its logging cadence), so this adds no device sync.
+    """
+    for k, v in metrics.items():
+        if not math.isfinite(v):
+            at = f" at step {step}" if step is not None else ""
+            raise TrainingDiverged(f"metric '{k}' is {v}{at}")
+
+
+class Watchdog:
+    """Detects a stalled training loop: ``beat()`` as the loop makes
+    progress; if no beat arrives within ``timeout`` seconds the
+    ``on_stall`` callback fires from the monitor thread (once per stall
+    episode — it re-arms when beats resume, so a transient pause that
+    recovers does not poison the rest of the run).
+
+    ``check()`` raises StallDetected from the calling thread only while a
+    stall is CURRENTLY in progress (beat age > timeout at call time); a
+    recovered episode never raises.  A training thread wedged inside a hung
+    collective can't raise for itself — for that case the on_stall callback
+    (e.g. the harness's 'stall' event emission) is the detection signal.
+
+    Contrast: the reference cannot detect a stall at all — a single dead
+    worker leaves every other thread waiting in Barrier.wait forever
+    (reference server.py:151, 90-96).
+    """
+
+    def __init__(self, timeout: float = 120.0,
+                 on_stall: Callable[[float], Any] | None = None,
+                 poll_interval: float | None = None):
+        self.timeout = timeout
+        self.on_stall = on_stall
+        self.stalled = False          # live view: currently in a stall?
+        self.stall_episodes = 0
+        self.stall_elapsed = 0.0      # beat age when the episode fired
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._poll = poll_interval if poll_interval is not None \
+            else max(0.05, timeout / 10.0)
+        self._thread = threading.Thread(target=self._monitor, daemon=True)
+        self._thread.start()
+
+    def beat(self) -> None:
+        with self._lock:
+            self._last = time.monotonic()
+
+    def _beat_age(self) -> float:
+        with self._lock:
+            return time.monotonic() - self._last
+
+    def check(self) -> None:
+        """Raise StallDetected if a stall is in progress right now."""
+        age = self._beat_age()
+        if age > self.timeout:
+            raise StallDetected(
+                f"no progress beat for {age:.1f}s (timeout {self.timeout}s)")
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self._poll):
+            elapsed = self._beat_age()
+            if elapsed > self.timeout:
+                if not self.stalled:  # fire once per episode
+                    self.stalled = True
+                    self.stall_episodes += 1
+                    self.stall_elapsed = elapsed
+                    if self.on_stall is not None:
+                        self.on_stall(elapsed)
+            else:
+                self.stalled = False  # beats resumed: re-arm
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def run_with_recovery(config, max_restarts: int = 2,
+                      run_fn: Callable | None = None,
+                      on_restart: Callable[[int, BaseException], Any] | None = None,
+                      ) -> dict[str, Any]:
+    """Run an experiment, restarting from the latest checkpoint on crash.
+
+    Requires ``config.checkpoint_dir`` (with ``checkpoint_every`` for
+    intra-run durability).  After a failure the config is re-run with
+    ``resume=True`` so the harness restores the newest checkpoint
+    (utils/harness.py run()); up to ``max_restarts`` retries, then the last
+    exception propagates.  Divergence (TrainingDiverged) is NOT retried —
+    restarting into the same NaN is not recovery.
+
+    ``run_fn`` is injectable for tests; defaults to harness.run.
+    """
+    import dataclasses
+
+    if run_fn is None:
+        from distributed_tensorflow_tpu.utils.harness import run as run_fn
+    if max_restarts > 0 and not config.checkpoint_dir:
+        raise ValueError("run_with_recovery needs config.checkpoint_dir to "
+                         "have anything to recover from")
+    attempt = 0
+    while True:
+        try:
+            summary = run_fn(config)
+            if attempt:
+                summary = dict(summary)
+                summary["restarts"] = attempt
+            return summary
+        except TrainingDiverged:
+            raise
+        except Exception as e:  # noqa: BLE001 — any crash is restartable
+            attempt += 1
+            if attempt > max_restarts:
+                raise
+            if on_restart is not None:
+                on_restart(attempt, e)
+            config = dataclasses.replace(config, resume=True)

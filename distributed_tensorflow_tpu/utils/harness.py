@@ -72,6 +72,11 @@ class ExperimentConfig:
                                            # enables mixed precision (params
                                            # stay f32, activations/matmuls
                                            # run bf16 on the MXU)
+    watchdog_timeout: float = 0.0          # >0: stall detector around the
+                                           # step loop (utils/failure.py)
+    nan_guard: bool = True                 # divergence check at log cadence
+    max_restarts: int = 0                  # >0: checkpoint-resume crash
+                                           # recovery (run_with_recovery)
 
 
 @dataclasses.dataclass
@@ -318,14 +323,28 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
 
     from distributed_tensorflow_tpu.utils.metrics import profile
 
+    watchdog = None
+    if config.watchdog_timeout > 0:
+        from distributed_tensorflow_tpu.utils.failure import Watchdog
+
+        watchdog = Watchdog(
+            timeout=config.watchdog_timeout,
+            on_stall=lambda el: sink.emit("stall", elapsed=el))
+
     sink.start()
-    with profile(config.profile_dir):
-        fit = trainer.fit(train_ds, epochs=config.epochs,
-                          batch_size=global_batch,
-                          log_every=config.log_every,
-                          checkpoint_manager=ckpt_mgr,
-                          checkpoint_every=config.checkpoint_every,
-                          metrics_logger=metrics_logger)
+    try:
+        with profile(config.profile_dir):
+            fit = trainer.fit(train_ds, epochs=config.epochs,
+                              batch_size=global_batch,
+                              log_every=config.log_every,
+                              checkpoint_manager=ckpt_mgr,
+                              checkpoint_every=config.checkpoint_every,
+                              metrics_logger=metrics_logger,
+                              watchdog=watchdog,
+                              nan_guard=config.nan_guard)
+    finally:
+        if watchdog is not None:
+            watchdog.close()
     sink.done(fit["elapsed"])
     ev = trainer.evaluate(test_ds, batch_size=config.eval_batch)
     sink.results(ev["accuracy"], loss=ev["loss"])

@@ -1,0 +1,143 @@
+"""Failure detection: divergence guard, stall watchdog, crash recovery."""
+
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.utils.failure import (
+    StallDetected, TrainingDiverged, Watchdog, check_finite,
+    run_with_recovery)
+from distributed_tensorflow_tpu.utils.harness import ExperimentConfig
+
+
+# ------------------------------------------------------------ check_finite
+def test_check_finite_passes():
+    check_finite({"loss": 0.5, "accuracy": 1.0})
+
+
+@pytest.mark.parametrize("bad", [float("nan"), float("inf"), float("-inf")])
+def test_check_finite_raises(bad):
+    with pytest.raises(TrainingDiverged, match="loss"):
+        check_finite({"loss": bad}, step=7)
+
+
+# ---------------------------------------------------------------- watchdog
+def test_watchdog_quiet_while_beating():
+    with Watchdog(timeout=0.3, poll_interval=0.05) as wd:
+        for _ in range(8):
+            time.sleep(0.05)
+            wd.beat()
+            wd.check()
+        assert not wd.stalled
+
+
+def test_watchdog_detects_stall():
+    fired = []
+    with Watchdog(timeout=0.15, poll_interval=0.03,
+                  on_stall=fired.append) as wd:
+        time.sleep(0.4)  # no beats
+        assert wd.stalled
+        assert fired and fired[0] > 0.15
+        with pytest.raises(StallDetected):
+            wd.check()
+
+
+def test_trainer_raises_on_nan(mesh8):
+    """A diverged loss surfaces as TrainingDiverged from fit()."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.data.loaders import Dataset
+    from distributed_tensorflow_tpu.engines.allreduce import Trainer
+
+    class NaNModel(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            h = nn.Dense(10)(x.reshape((x.shape[0], -1)))
+            return h / 0.0  # NaN/inf logits from step one
+
+    x = np.random.default_rng(0).random((64, 4), np.float32)
+    y = (np.arange(64) % 10).astype(np.int32)
+    ds = Dataset(x=x, y=y, num_classes=10)
+    tr = Trainer(NaNModel(), mesh=None)
+    with pytest.raises(TrainingDiverged):
+        tr.fit(ds, epochs=1, batch_size=16, log_every=1, log_fn=lambda s: None)
+
+
+# ---------------------------------------------------------------- recovery
+def test_run_with_recovery_requires_checkpoint_dir():
+    cfg = ExperimentConfig()
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        run_with_recovery(cfg, max_restarts=1, run_fn=lambda c: {})
+
+
+def test_run_with_recovery_restarts_with_resume(tmp_path):
+    cfg = ExperimentConfig(checkpoint_dir=str(tmp_path))
+    calls = []
+
+    def flaky_run(config):
+        calls.append(config.resume)
+        if len(calls) < 3:
+            raise RuntimeError(f"crash {len(calls)}")
+        return {"ok": True}
+
+    restarts = []
+    out = run_with_recovery(cfg, max_restarts=2, run_fn=flaky_run,
+                            on_restart=lambda n, e: restarts.append(str(e)))
+    assert out == {"ok": True, "restarts": 2}
+    assert calls == [False, True, True]  # resume flips on after first crash
+    assert restarts == ["crash 1", "crash 2"]
+
+
+def test_run_with_recovery_exhausts_restarts(tmp_path):
+    cfg = ExperimentConfig(checkpoint_dir=str(tmp_path))
+
+    def always_crash(config):
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        run_with_recovery(cfg, max_restarts=2, run_fn=always_crash)
+
+
+def test_run_with_recovery_no_retry_on_divergence(tmp_path):
+    cfg = ExperimentConfig(checkpoint_dir=str(tmp_path))
+    calls = []
+
+    def diverge(config):
+        calls.append(1)
+        raise TrainingDiverged("loss is nan")
+
+    with pytest.raises(TrainingDiverged):
+        run_with_recovery(cfg, max_restarts=5, run_fn=diverge)
+    assert len(calls) == 1  # restarting into the same NaN is not recovery
+
+
+def test_recovery_end_to_end_resumes_from_checkpoint(tmp_path):
+    """Crash mid-training → run_with_recovery resumes from the checkpoint
+    and the final step count continues (not restarts) the original run."""
+    from distributed_tensorflow_tpu.utils import harness
+
+    cfg = ExperimentConfig(
+        engine="sync", model="mlp", dataset="synthetic", n_devices=8,
+        batch_size=8, epochs=2, log_every=0,
+        checkpoint_dir=str(tmp_path), checkpoint_every=10)
+
+    crashed = {"done": False}
+    real_run = harness.run
+
+    def crash_once(config):
+        if not crashed["done"]:
+            crashed["done"] = True
+            # run a short real training to write checkpoints, then "crash"
+            short = dataclasses.replace(config, epochs=1)
+            real_run(short)
+            raise RuntimeError("injected crash after epoch 1")
+        return real_run(config)
+
+    out = run_with_recovery(cfg, max_restarts=1, run_fn=crash_once)
+    assert out["restarts"] == 1
+    # resumed run trained on top of the checkpoint: steps continue
+    assert out["steps"] > 0
+    assert out["test_accuracy"] > 0.5

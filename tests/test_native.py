@@ -111,6 +111,22 @@ def test_native_listen_connect_accept():
     assert results["msg"] == b"syn"
 
 
+# --------------------------------------------------------- race detection
+def test_pipeline_under_thread_sanitizer():
+    """TSAN over the producer/worker-pool/consumer concurrency (the race
+    detection the reference lacks outright, SURVEY.md §5)."""
+    import subprocess
+
+    binary = native.build_race_test()
+    if binary is None:
+        pytest.skip("TSAN unavailable")
+    proc = subprocess.run([str(binary)], capture_output=True, text=True,
+                          timeout=120)
+    assert "WARNING: ThreadSanitizer" not in proc.stderr, proc.stderr[:4000]
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[:2000])
+    assert "tsan-driver-ok" in proc.stdout
+
+
 # --------------------------------------------------------------- pipeline
 def _ref_batches(x, y, bs, **kw):
     return list(iter_batches(x, y, bs, **kw))
