@@ -118,8 +118,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="model compute dtype; bfloat16 = mixed precision "
                         "(f32 params, bf16 activations on the MXU)")
     p.add_argument("--watchdog-timeout", type=float, default=0.0,
-                   help=">0: raise StallDetected if no step completes within "
-                        "this many seconds (the reference deadlocks instead)")
+                   help=">0: detect a stalled step loop (no progress for this "
+                        "many seconds) and emit a 'stall' event — the "
+                        "reference deadlocks silently instead")
+    p.add_argument("--watchdog-abort", action="store_true",
+                   help="on stall, exit(75) after reporting so a supervisor "
+                        "can relaunch with --resume (a wedged XLA runtime "
+                        "cannot be recovered in-process)")
     p.add_argument("--no-nan-guard", action="store_true",
                    help="disable the divergence (NaN/inf loss) check")
     p.add_argument("--max-restarts", type=int, default=0,
@@ -187,15 +192,11 @@ def main(argv: list[str] | None = None) -> dict:
         profile_dir=args.profile_dir,
         dtype=args.dtype,
         watchdog_timeout=args.watchdog_timeout,
+        watchdog_abort=args.watchdog_abort,
         nan_guard=not args.no_nan_guard,
         max_restarts=args.max_restarts,
     )
-    if args.max_restarts > 0:
-        from distributed_tensorflow_tpu.utils.failure import run_with_recovery
-
-        summary = run_with_recovery(config, max_restarts=args.max_restarts)
-    else:
-        summary = run(config)
+    summary = run(config)  # run() itself wraps recovery when max_restarts>0
     print(json.dumps(summary))
     return summary
 

@@ -75,15 +75,18 @@ class Trainer:
             for bx, by, _ in train_ds.batches(
                     bs, shuffle=True, seed=self.seed, epoch=epoch,
                     drop_remainder=True):
-                if watchdog is not None:
-                    watchdog.beat()  # loop liveness: throttling bounds how
-                    # far this can run ahead of actual device progress
                 with timer:  # amortized dispatch+throttle time (see result)
                     xs, ys = self.engine.shard_batch(bx, by)
                     self.state, metrics = eng.step(self.state, xs, ys)
                     in_flight.append(metrics)
                     if len(in_flight) > self.max_in_flight:
                         jax.block_until_ready(in_flight.pop(0))
+                if watchdog is not None:
+                    # beat AFTER dispatch+throttle: the first beat arms the
+                    # clock past the first-step XLA compile, and throttling
+                    # bounds how far this loop runs ahead of the device, so
+                    # a hung collective stops the beats within the window
+                    watchdog.beat()
                 steps += 1
                 gstep = start_step + steps
                 examples += len(bx)

@@ -78,6 +78,13 @@ class Engine:
         opt_state = self.tx.init(params)
         state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                            opt_state=opt_state, rng=rng)
+        if jax.process_count() > 1:
+            # every process computed the same state (same rng); a jit
+            # identity with replicated out_shardings turns the process-local
+            # copies into one global replicated array (device_put can't
+            # target non-addressable devices)
+            return jax.jit(lambda s: s,
+                           out_shardings=meshlib.replicated(self.mesh))(state)
         return jax.device_put(state, meshlib.replicated(self.mesh))
 
     # ------------------------------------------------------------- batches
@@ -87,11 +94,12 @@ class Engine:
         Replaces per-worker dataset sharding (reference initializer.py:44):
         one host batch feeds all devices.
         """
-        xs = jax.device_put(x, meshlib.data_sharding(self.mesh, x.ndim))
-        ys = jax.device_put(y, meshlib.data_sharding(self.mesh, y.ndim))
+        xs = meshlib.host_to_global(x, meshlib.data_sharding(self.mesh, x.ndim))
+        ys = meshlib.host_to_global(y, meshlib.data_sharding(self.mesh, y.ndim))
         if mask is None:
             return xs, ys
-        ms = jax.device_put(mask, meshlib.data_sharding(self.mesh, mask.ndim))
+        ms = meshlib.host_to_global(mask,
+                                    meshlib.data_sharding(self.mesh, mask.ndim))
         return xs, ys, ms
 
     # ---------------------------------------------------------------- step

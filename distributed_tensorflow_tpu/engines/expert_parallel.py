@@ -57,11 +57,11 @@ class ExpertParallelEngine(Engine):
         return NamedSharding(self.mesh, spec)
 
     def shard_batch(self, x, y, mask=None):
-        xs = jax.device_put(x, self._batch_sharding(x.ndim))
-        ys = jax.device_put(y, self._batch_sharding(y.ndim))
+        xs = meshlib.host_to_global(x, self._batch_sharding(x.ndim))
+        ys = meshlib.host_to_global(y, self._batch_sharding(y.ndim))
         if mask is None:
             return xs, ys
-        ms = jax.device_put(mask, self._batch_sharding(mask.ndim))
+        ms = meshlib.host_to_global(mask, self._batch_sharding(mask.ndim))
         return xs, ys, ms
 
     def init_state(self, rng, sample_x) -> TrainState:

@@ -98,6 +98,23 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def host_to_global(arr, sharding: NamedSharding):
+    """Place a host array (same values on every process) onto a mesh.
+
+    Single-process: plain device_put.  Multi-process: device_put rejects
+    shardings spanning non-addressable devices, so build the global array
+    via make_array_from_callback — each process serves exactly its
+    addressable shards from its host copy (the multi-host rendering of the
+    reference's per-worker dataset shard, reference initializer.py:44).
+    """
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(
+        np.shape(arr), sharding, lambda idx: arr[idx])
+
+
 def data_sharding(mesh: Mesh, ndim: int = 1, axis: str = DATA_AXIS) -> NamedSharding:
     """Sharding for a batch: leading dim split over the data axis."""
     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))

@@ -67,13 +67,18 @@ class Watchdog:
 
     def __init__(self, timeout: float = 120.0,
                  on_stall: Callable[[float], Any] | None = None,
-                 poll_interval: float | None = None):
+                 poll_interval: float | None = None,
+                 arm_on_first_beat: bool = True):
         self.timeout = timeout
         self.on_stall = on_stall
         self.stalled = False          # live view: currently in a stall?
         self.stall_episodes = 0
         self.stall_elapsed = 0.0      # beat age when the episode fired
-        self._last = time.monotonic()
+        # arm_on_first_beat: don't count the window before the first beat —
+        # the first training step's blocking XLA compile routinely exceeds
+        # any sane stall timeout and would fire a false episode.  Tradeoff:
+        # a hang during the very first compile goes undetected.
+        self._last = None if arm_on_first_beat else time.monotonic()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._poll = poll_interval if poll_interval is not None \
@@ -87,6 +92,8 @@ class Watchdog:
 
     def _beat_age(self) -> float:
         with self._lock:
+            if self._last is None:  # not armed yet (no first beat)
+                return 0.0
             return time.monotonic() - self._last
 
     def check(self) -> None:

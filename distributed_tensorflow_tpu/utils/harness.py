@@ -13,6 +13,7 @@ build was included in the reference's window.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Callable
 
@@ -74,6 +75,11 @@ class ExperimentConfig:
                                            # run bf16 on the MXU)
     watchdog_timeout: float = 0.0          # >0: stall detector around the
                                            # step loop (utils/failure.py)
+    watchdog_abort: bool = False           # on stall: report, then exit(75)
+                                           # for an external relaunch with
+                                           # resume (in-process recovery of
+                                           # a wedged XLA runtime is not
+                                           # possible)
     nan_guard: bool = True                 # divergence check at log cadence
     max_restarts: int = 0                  # >0: checkpoint-resume crash
                                            # recovery (run_with_recovery)
@@ -284,7 +290,17 @@ def _setup_expert_parallel(config: ExperimentConfig) -> _Experiment:
 
 
 def run(config: ExperimentConfig) -> dict[str, Any]:
-    """Run one experiment; returns the summary dict (also emitted as JSONL)."""
+    """Run one experiment; returns the summary dict (also emitted as JSONL).
+
+    With ``max_restarts > 0`` the run is wrapped in checkpoint-resume crash
+    recovery (utils/failure.py run_with_recovery).
+    """
+    if config.max_restarts > 0:
+        from distributed_tensorflow_tpu.utils.failure import run_with_recovery
+
+        return run_with_recovery(
+            dataclasses.replace(config, max_restarts=0),
+            max_restarts=config.max_restarts, run_fn=run)
     ex = _setup(config)
     n, train_ds, test_ds = ex.n, ex.train_ds, ex.test_ds
     global_batch = ex.global_batch
@@ -327,9 +343,17 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     if config.watchdog_timeout > 0:
         from distributed_tensorflow_tpu.utils.failure import Watchdog
 
-        watchdog = Watchdog(
-            timeout=config.watchdog_timeout,
-            on_stall=lambda el: sink.emit("stall", elapsed=el))
+        def _on_stall(elapsed: float) -> None:
+            sink.emit("stall", elapsed=elapsed)
+            if config.watchdog_abort:
+                # the step loop is wedged inside the XLA runtime; no Python
+                # exception can reach it — exit so a supervisor relaunches
+                # with --resume (EX_TEMPFAIL)
+                sink.close()
+                os._exit(75)
+
+        watchdog = Watchdog(timeout=config.watchdog_timeout,
+                            on_stall=_on_stall)
 
     sink.start()
     try:

@@ -37,11 +37,33 @@ def test_watchdog_detects_stall():
     fired = []
     with Watchdog(timeout=0.15, poll_interval=0.03,
                   on_stall=fired.append) as wd:
-        time.sleep(0.4)  # no beats
+        wd.beat()  # arm (the clock starts at the first beat)
+        time.sleep(0.4)  # then no beats
         assert wd.stalled
         assert fired and fired[0] > 0.15
         with pytest.raises(StallDetected):
             wd.check()
+
+
+def test_watchdog_unarmed_before_first_beat():
+    """No false stall during the first-step XLA compile window."""
+    with Watchdog(timeout=0.1, poll_interval=0.02) as wd:
+        time.sleep(0.3)  # 'compiling': no beats yet
+        assert not wd.stalled
+        wd.check()  # does not raise
+
+
+def test_watchdog_rearms_after_recovery():
+    """A transient pause that recovers must not poison later checks."""
+    with Watchdog(timeout=0.12, poll_interval=0.02) as wd:
+        wd.beat()
+        time.sleep(0.3)  # stall episode fires
+        assert wd.stalled
+        wd.beat()       # progress resumes
+        time.sleep(0.06)
+        assert not wd.stalled  # monitor re-armed
+        wd.check()      # recovered episode never raises
+        assert wd.stall_episodes == 1
 
 
 def test_trainer_raises_on_nan(mesh8):
@@ -112,6 +134,16 @@ def test_run_with_recovery_no_retry_on_divergence(tmp_path):
     with pytest.raises(TrainingDiverged):
         run_with_recovery(cfg, max_restarts=5, run_fn=diverge)
     assert len(calls) == 1  # restarting into the same NaN is not recovery
+
+
+def test_harness_run_dispatches_recovery():
+    """max_restarts on the config is honored by harness.run itself
+    (programmatic path, not just the CLI)."""
+    from distributed_tensorflow_tpu.utils import harness
+
+    cfg = ExperimentConfig(max_restarts=1)  # no checkpoint_dir
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        harness.run(cfg)
 
 
 def test_recovery_end_to_end_resumes_from_checkpoint(tmp_path):
