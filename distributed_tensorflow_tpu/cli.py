@@ -146,7 +146,11 @@ def select_engine(args: argparse.Namespace) -> str:
     return "sync"  # tpu_pod
 
 
-def main(argv: list[str] | None = None) -> dict:
+def main(argv: list[str] | None = None, *, model_fn=None,
+         dataset_fn=None) -> dict:
+    """CLI entry.  ``model_fn``/``dataset_fn`` are the reference's user
+    plug-in contract (reference README.md:12: "edit model_fn/dataset_fn in
+    initializer.py"): when provided they override --model/--dataset."""
     args = build_parser().parse_args(argv)
 
     if args.task_type is not None and args.server_address is not None:
@@ -167,6 +171,8 @@ def main(argv: list[str] | None = None) -> dict:
         engine=select_engine(args),
         model=args.model,
         dataset=args.dataset,
+        model_fn=model_fn,
+        dataset_fn=dataset_fn,
         n_devices=args.number_nodes,
         batch_size=args.batch_size,
         epochs=args.epochs,

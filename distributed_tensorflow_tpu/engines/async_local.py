@@ -41,7 +41,8 @@ class AsyncLocalEngine(Engine):
         n = self.n_devices
         stacked = jax.tree.map(
             lambda a: jnp.broadcast_to(a, (n, *jnp.shape(a))), state)
-        return jax.device_put(stacked, meshlib.per_device_sharding(self.mesh))
+        return meshlib.state_to_global(stacked,
+                                       meshlib.per_device_sharding(self.mesh))
 
     def _build_step(self):
         loss_fn = make_loss_fn(self.model.apply)
@@ -85,5 +86,5 @@ class AsyncLocalEngine(Engine):
         def mean_params(p):
             return jax.tree.map(lambda a: a.mean(axis=0), p)
 
-        return jax.device_put(mean_params(state.params),
-                              meshlib.replicated(self.mesh))
+        return meshlib.state_to_global(mean_params(state.params),
+                                       meshlib.replicated(self.mesh))

@@ -78,14 +78,9 @@ class Engine:
         opt_state = self.tx.init(params)
         state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                            opt_state=opt_state, rng=rng)
-        if jax.process_count() > 1:
-            # every process computed the same state (same rng); a jit
-            # identity with replicated out_shardings turns the process-local
-            # copies into one global replicated array (device_put can't
-            # target non-addressable devices)
-            return jax.jit(lambda s: s,
-                           out_shardings=meshlib.replicated(self.mesh))(state)
-        return jax.device_put(state, meshlib.replicated(self.mesh))
+        # every process computed the same state (same rng); state_to_global
+        # makes it one global replicated array on multi-process meshes
+        return meshlib.state_to_global(state, meshlib.replicated(self.mesh))
 
     # ------------------------------------------------------------- batches
     def shard_batch(self, x: np.ndarray, y: np.ndarray, mask: np.ndarray | None = None):

@@ -144,7 +144,7 @@ class PipelineEngine(Engine):
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), _pipe_spec_tree(state),
             is_leaf=lambda x: isinstance(x, P))
-        return jax.device_put(state, shardings)
+        return meshlib.state_to_global(state, shardings)
 
     # ------------------------------------------------------------- forward
     def _sequential_logits(self, params, x):

@@ -69,15 +69,17 @@ class SeqParallelEngine(Engine):
         opt_state = self.tx.init(params)
         state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                            opt_state=opt_state, rng=rng)
-        return jax.device_put(state, meshlib.replicated(self.mesh))
+        return meshlib.state_to_global(state, meshlib.replicated(self.mesh))
 
     def shard_batch(self, x, y, mask=None):
-        xs = jax.device_put(x, NamedSharding(
+        xs = meshlib.host_to_global(x, NamedSharding(
             self.mesh, P(meshlib.DATA_AXIS, meshlib.SEQ_AXIS)))
-        ys = jax.device_put(y, NamedSharding(self.mesh, P(meshlib.DATA_AXIS)))
+        ys = meshlib.host_to_global(
+            y, NamedSharding(self.mesh, P(meshlib.DATA_AXIS)))
         if mask is None:
             return xs, ys
-        ms = jax.device_put(mask, NamedSharding(self.mesh, P(meshlib.DATA_AXIS)))
+        ms = meshlib.host_to_global(
+            mask, NamedSharding(self.mesh, P(meshlib.DATA_AXIS)))
         return xs, ys, ms
 
     def _build_step(self):

@@ -115,6 +115,21 @@ def host_to_global(arr, sharding: NamedSharding):
         np.shape(arr), sharding, lambda idx: arr[idx])
 
 
+def state_to_global(tree, shardings):
+    """Place a pytree of device values (identical on every process) onto the
+    mesh with the given sharding(s).
+
+    Single-process: plain device_put.  Multi-process: a jit identity with
+    ``out_shardings`` — jit treats the process-local inputs as replicated
+    global values and emits the resharding, which device_put cannot do for
+    non-addressable devices.  Handles typed PRNG-key leaves, unlike
+    make_array_from_callback.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(tree, shardings)
+    return jax.jit(lambda s: s, out_shardings=shardings)(tree)
+
+
 def data_sharding(mesh: Mesh, ndim: int = 1, axis: str = DATA_AXIS) -> NamedSharding:
     """Sharding for a batch: leading dim split over the data axis."""
     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))

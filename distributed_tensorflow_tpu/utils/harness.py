@@ -18,6 +18,7 @@ import time
 from typing import Any, Callable
 
 import jax
+import numpy as np
 
 from distributed_tensorflow_tpu import models as modellib
 from distributed_tensorflow_tpu.data import loaders
@@ -182,6 +183,11 @@ def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
     mesh, dp = _split_mesh(config, config.seq_parallel, "seq_parallel",
                            meshlib.SEQ_AXIS)
     train_ds, test_ds = _load_data(config)
+    if not np.issubdtype(train_ds.x.dtype, np.integer):
+        raise ValueError(
+            f"seq_parallel needs a token dataset (integer ids), got "
+            f"--dataset {config.dataset} with dtype {train_ds.x.dtype}; "
+            f"use --dataset glue_synth")
     if config.model_fn is not None:
         model = config.model_fn()
     elif config.model in _SEQUENCE_MODELS:
@@ -301,6 +307,9 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         return run_with_recovery(
             dataclasses.replace(config, max_restarts=0),
             max_restarts=config.max_restarts, run_fn=run)
+    if config.watchdog_abort and config.watchdog_timeout <= 0:
+        raise ValueError("watchdog_abort requires watchdog_timeout > 0 "
+                         "(nothing would ever detect the stall)")
     ex = _setup(config)
     n, train_ds, test_ds = ex.n, ex.train_ds, ex.test_ds
     global_batch = ex.global_batch
@@ -385,9 +394,11 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         engine_name = config.engine
     total_devices = (n * config.seq_parallel * config.tensor_parallel
                      * config.pipeline_parallel * config.expert_parallel)
+    model_name = config.model if config.model_fn is None else getattr(
+        config.model_fn, "__name__", "custom_model_fn")
     summary = {
         "engine": engine_name,
-        "model": config.model,
+        "model": model_name,
         "dataset": train_ds.name,
         "synthetic_data": train_ds.synthetic,
         "n_devices": total_devices,

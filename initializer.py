@@ -1,32 +1,41 @@
 #!/usr/bin/env python
 """Launcher — drop-in role of the reference's initializer.py.
 
-Like the reference (reference README.md:12), users may plug in their own
-``model_fn`` / ``dataset_fn`` below; unlike the reference these are passed
-explicitly (no fork-inherited globals, SURVEY.md §2.4(5)).
+Like the reference (reference README.md:12), users plug in their own
+``model_fn`` / ``dataset_fn`` by editing this file; unlike the reference
+they are passed explicitly into the CLI (no fork-inherited globals,
+SURVEY.md §2.4(5)).  Leave them as None to use --model/--dataset.
 
 Examples:
-  python initializer.py -m tpu_pod --dataset mnist --model mlp -n 8 -b 32
+  python initializer.py -m tpu_pod --dataset mnist --model cnn -n 8 -b 32
   python initializer.py -m c -cs sync -n 4 -b 32      # PS-sync semantics
   python initializer.py -m c -cs async -n 4 -b 32     # local-SGD async
   python initializer.py -m d -ds keras -n 4 -b 32     # allreduce
   python initializer.py -m d -ds custom -n 4 -d 2     # gossip ring, degree 2
+  python initializer.py -m t --model bert_tiny --dataset glue_synth -sp 4
+  python initializer.py -m t --model moe -ep 4 --num-experts 8
+  python initializer.py -m t -tp 4 --dtype bf16       # Megatron TP + bf16
 """
 
 from distributed_tensorflow_tpu.cli import main
 
-# --- user plug-in point (optional) -----------------------------------------
-# def model_fn():
-#     import flax.linen as nn
-#     from distributed_tensorflow_tpu.models.mlp import MLP
-#     return MLP(num_classes=10)
+# --- user plug-in point (reference README.md:12) ---------------------------
+# Edit these like the reference's initializer.py model_fn/dataset_fn.
+# model_fn() -> flax.linen.Module with __call__(x, train: bool) -> logits
+# dataset_fn(batch_size, type='train'|'test', shard=False, index=0,
+#            buffer_size=10000, reshape=True, n_shards=1) -> data.Dataset
 #
-# def dataset_fn(batch_size, type="train", shard=False, index=0,
-#                buffer_size=10000, reshape=True, n_shards=1):
-#     from distributed_tensorflow_tpu.data import make_dataset_fn
-#     return make_dataset_fn("mnist")(batch_size, type, shard, index,
-#                                     buffer_size, reshape, n_shards)
+# Example:
+#   def model_fn():
+#       from distributed_tensorflow_tpu.models.mlp import MLP
+#       return MLP(num_classes=10, hidden=512)
+#
+#   from distributed_tensorflow_tpu.data import make_dataset_fn
+#   dataset_fn = make_dataset_fn("mnist")
+
+model_fn = None
+dataset_fn = None
 # ---------------------------------------------------------------------------
 
 if __name__ == "__main__":
-    main()
+    main(model_fn=model_fn, dataset_fn=dataset_fn)

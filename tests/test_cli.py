@@ -73,3 +73,24 @@ def test_steps_to_accuracy_step_granularity():
     assert r["reached"], r
     assert r["steps"] % 8 == 0  # eval cadence honored
     assert r["steps"] < 300
+
+
+def test_cli_user_plugin_model_and_dataset_fn():
+    """The reference's 'edit model_fn/dataset_fn in initializer.py' contract
+    (reference README.md:12): plug-ins override --model/--dataset."""
+    from distributed_tensorflow_tpu.data import make_dataset_fn
+    from distributed_tensorflow_tpu.models.mlp import MLP
+
+    built = {}
+
+    def model_fn():
+        built["model"] = True
+        return MLP(num_classes=10, hidden=16)
+
+    summary = main(
+        ["-m", "tpu_pod", "-n", "8", "-b", "8", "--log-every", "0",
+         "--model", "ignored_because_plugin", "--dataset", "synthetic"],
+        model_fn=model_fn, dataset_fn=make_dataset_fn("synthetic"))
+    assert built.get("model")
+    assert summary["steps"] > 0
+    assert summary["test_accuracy"] > 0.5

@@ -99,6 +99,15 @@ for _ in range(10):
 jax.block_until_ready(state)
 l0, l1 = float(first["loss"]), float(m["loss"])
 assert l1 < l0, (l0, l1)
+
+# async engine: per-device stacked state placement across processes
+from distributed_tensorflow_tpu.engines import AsyncLocalEngine
+
+aeng = AsyncLocalEngine(model, mesh=mesh, learning_rate=1e-2, sync_every=2)
+astate = aeng.init_state(jax.random.key(1), x)
+astate, am = aeng.step(astate, *aeng.shard_batch(x, y))
+jax.block_until_ready(astate)
+assert float(am["loss"]) > 0.0
 print("MULTIHOST_TRAIN_OK", l0, l1)
 """
 
