@@ -28,6 +28,7 @@ from distributed_tensorflow_tpu.engines.tensor_parallel import (  # noqa: F401
 from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine  # noqa: F401
 from distributed_tensorflow_tpu.engines.expert_parallel import (  # noqa: F401
     ExpertParallelEngine)
+from distributed_tensorflow_tpu.engines.composite import CompositeEngine  # noqa: F401
 
 ENGINES = {
     "sync": SyncEngine,

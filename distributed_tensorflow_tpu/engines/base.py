@@ -158,23 +158,40 @@ class Engine:
         rng = jax.random.fold_in(state_rng, step)
         return jax.random.fold_in(rng, coll.axis_index(self.axis))
 
-    def _init_partitioned_state(self, rng: jax.Array, sample_x) -> TrainState:
+    def _init_partitioned_state(self, rng: jax.Array, sample_x,
+                                init_model=None) -> TrainState:
         """Sharded init for GSPMD engines: abstract-eval the init to read
         the model's `with_partitioning` annotations, then jit-init with
         those shardings so large params materialize already sharded (never
-        replicated-then-resharded).  Unannotated params replicate."""
+        replicated-then-resharded).  Unannotated params replicate.
+
+        The returned state is UNBOXED (plain arrays, no `nn.Partitioned`
+        wrappers): the annotations' only runtime job is done once the arrays
+        carry their NamedShardings, and boxed leaves break under
+        partial-manual shard_map — flax re-applies each box's spec via
+        with_sharding_constraint at apply time, which crashes on
+        DenseGeneral's pre-reshape kernels (rank-2 value, rank-3 spec).
+
+        ``init_model`` optionally substitutes a structurally-identical module
+        for tracing init (e.g. a dense-attention twin when the engine's model
+        needs in-shard_map collectives that can't trace here).
+        """
         import flax.linen as nn
         from jax.sharding import NamedSharding
 
         x = jnp.asarray(sample_x[:1])
+        module = init_model if init_model is not None else self.model
 
-        def init_fn(rng):
-            params = self.model.init(rng, x, train=False)["params"]
+        def boxed_init(rng):
+            params = module.init(rng, x, train=False)["params"]
             opt_state = self.tx.init(params)
             return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                               opt_state=opt_state, rng=rng)
 
-        abstract = jax.eval_shape(init_fn, rng)
+        def init_fn(rng):
+            return nn.unbox(boxed_init(rng))
+
+        abstract = jax.eval_shape(boxed_init, rng)
         specs = nn.get_partition_spec(abstract)
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), specs,

@@ -1,0 +1,161 @@
+"""Composed parallelism: dp × tp × sp on one 3-D mesh.
+
+No reference counterpart (the reference's only axis is data parallelism over
+worker processes, SURVEY.md §2.2) — this is the TPU-native "pick a mesh,
+annotate shardings, let XLA insert collectives" recipe applied across three
+axes at once:
+
+* ``data``  — batch sharding, GSPMD (compiler inserts the gradient
+  all-reduce exactly as in engines/tensor_parallel.py).
+* ``model`` — Megatron tensor parallelism via the model's
+  ``with_partitioning`` annotations (models/bert.py ``partition_model``),
+  also GSPMD.
+* ``seq``   — ring/Ulysses context parallelism via **partial-manual**
+  ``jax.shard_map`` (``axis_names={'seq'}``): the step body is manual over
+  ``seq`` — so ring attention's explicit ``ppermute`` schedule rides ICI
+  neighbor links — while ``data``/``model`` stay in GSPMD's hands inside the
+  same program.  With ``seq`` size 1 (or dense attention) the step is a
+  plain jit and the mesh degenerates to the tensor-parallel engine's.
+
+Gradient bookkeeping under the manual ``seq`` axis: parameters enter the
+shard_map seq-invariant (``P()``), every seq device computes the global-mean
+loss through its token block, and shard_map's AD transpose psums the partial
+parameter cotangents over ``seq`` at the invariant boundary — no explicit
+gradient collectives, same argument as engines/seq_parallel.py but with the
+``data`` mean handled by GSPMD instead of a manual pmean.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.engines.base import (
+    Engine, TrainState, cross_entropy)
+from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+
+class CompositeEngine(Engine):
+    """Sync training over a ('data', 'model', 'seq') mesh.
+
+    Any axis may have size 1; ``seq`` > 1 requires a model whose
+    ``attention_impl`` is 'ring' or 'ulysses' (dense attention on
+    seq-sharded activations would attend within local blocks only).
+    """
+
+    seq_axis = meshlib.SEQ_AXIS
+
+    def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3):
+        if mesh is None or meshlib.DATA_AXIS not in mesh.axis_names:
+            raise ValueError("CompositeEngine requires a mesh with a 'data' "
+                             "axis (plus optional 'model'/'seq')")
+        extra = set(mesh.axis_names) - {meshlib.DATA_AXIS, meshlib.MODEL_AXIS,
+                                        meshlib.SEQ_AXIS}
+        if extra:
+            raise ValueError(f"unsupported mesh axes {sorted(extra)}; "
+                             f"CompositeEngine composes data×model×seq")
+        super().__init__(model, optimizer, mesh, learning_rate)
+        self.seq_n = mesh.shape.get(meshlib.SEQ_AXIS, 1)
+        self.tp_n = mesh.shape.get(meshlib.MODEL_AXIS, 1)
+        impl = getattr(model, "attention_impl", "dense")
+        if self.seq_n > 1 and impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"seq axis size {self.seq_n} needs attention_impl 'ring' or "
+                f"'ulysses', got '{impl}'")
+        if self.seq_n == 1 and impl in ("ring", "ulysses"):
+            # degenerate seq axis: the manual collectives would reference an
+            # unbound axis in the plain-jit path — swap in the dense twin
+            # (identical params/math on an unsharded sequence)
+            self.model = model.clone(attention_impl="dense")
+        self._manual_seq = self.seq_n > 1
+
+    # ------------------------------------------------------------------ init
+    def init_state(self, rng, sample_x) -> TrainState:
+        """Init via a dense-attention twin (ring/Ulysses collectives cannot
+        trace outside shard_map; param structure is identical) with GSPMD
+        shardings read from the model's partitioning annotations."""
+        twin = self.model
+        if getattr(twin, "attention_impl", "dense") in ("ring", "ulysses"):
+            twin = twin.clone(attention_impl="dense")
+        return self._init_partitioned_state(rng, sample_x, init_model=twin)
+
+    # --------------------------------------------------------------- batches
+    def shard_batch(self, x, y, mask=None):
+        if self._manual_seq:
+            if x.ndim < 2:
+                raise ValueError("seq sharding needs (batch, seq, ...) input")
+            if x.shape[1] % self.seq_n:
+                raise ValueError(f"sequence length {x.shape[1]} not divisible "
+                                 f"by seq axis size {self.seq_n}")
+        xspec = (P(self.axis, self.seq_axis) if self._manual_seq
+                 else P(self.axis, *([None] * (x.ndim - 1))))
+        xs = meshlib.host_to_global(x, NamedSharding(self.mesh, xspec))
+        ys = meshlib.host_to_global(y, NamedSharding(self.mesh, P(self.axis)))
+        if mask is None:
+            return xs, ys
+        ms = meshlib.host_to_global(mask,
+                                    NamedSharding(self.mesh, P(self.axis)))
+        return xs, ys, ms
+
+    # ------------------------------------------------------------------ step
+    def _build_step(self):
+        apply_fn = self.model.apply
+        tx = self.tx
+        seq_axis, manual = self.seq_axis, self._manual_seq
+
+        def train_step(state: TrainState, x, y):
+            rng = jax.random.fold_in(state.rng, state.step)
+            if manual:
+                # per-seq-device dropout masks: activations are token blocks,
+                # a shared mask would drop the same local offsets everywhere
+                rng = jax.random.fold_in(rng, coll.axis_index(seq_axis))
+
+            def loss_fn(params):
+                logits = apply_fn({"params": params}, x, train=True,
+                                  rngs={"dropout": rng})
+                # global-batch mean: 'data' is a GSPMD axis in both paths, so
+                # the mean is global as written; over 'seq' the loss is
+                # invariant (logits come from the [CLS] broadcast)
+                loss = cross_entropy(logits, y).mean()
+                acc = (logits.argmax(-1) == y).mean()
+                return loss, acc
+
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return state.replace(step=state.step + 1, params=params,
+                                 opt_state=opt_state), \
+                {"loss": loss, "accuracy": acc}
+
+        if not manual:
+            return jax.jit(train_step, donate_argnums=0)
+        smapped = jax.shard_map(
+            train_step, mesh=self.mesh, axis_names={seq_axis},
+            in_specs=(P(), P(None, seq_axis), P()),
+            out_specs=(P(), P()),
+        )
+        return jax.jit(smapped, donate_argnums=0)
+
+    # ------------------------------------------------------------------ eval
+    def _build_eval(self):
+        apply_fn = self.model.apply
+        seq_axis, manual = self.seq_axis, self._manual_seq
+
+        def eval_step(params, x, y, mask):
+            logits = apply_fn({"params": params}, x, train=False)
+            correct = ((logits.argmax(-1) == y) * mask).sum()
+            loss_sum = (cross_entropy(logits, y) * mask).sum()
+            return correct, loss_sum, mask.sum()
+
+        if not manual:
+            return jax.jit(eval_step)
+        smapped = jax.shard_map(
+            eval_step, mesh=self.mesh, axis_names={seq_axis},
+            in_specs=(P(), P(None, seq_axis), P(), P()),
+            out_specs=(P(), P(), P()),
+        )
+        return jax.jit(smapped)

@@ -20,6 +20,14 @@ Input is int32 token ids (B, L_local); 0 is the padding id and is masked out
 of attention.  The classification head reads the [CLS] position (global
 index 0); under sequence parallelism only seq-device 0 holds it, so the head
 uses a broadcast from that device.
+
+``partition_model=True`` adds Megatron-style ``with_partitioning``
+annotations over the ``model`` mesh axis for GSPMD tensor parallelism
+(engines/tensor_parallel.py): QKV projections column-parallel (heads
+sharded), attention output row-parallel, FFN split column→row, token
+embedding vocab-sharded.  The activation between each col/row pair stays
+model-sharded and XLA emits exactly one all-reduce per pair — no reference
+counterpart (the reference replicates whole models, reference client.py:72).
 """
 
 from __future__ import annotations
@@ -28,8 +36,16 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
 from distributed_tensorflow_tpu.parallel.ring_attention import (
     dense_attention, ring_attention, ulysses_attention)
+
+
+def _part(init, spec, enabled: bool):
+    """Megatron annotation, applied only when the model is TP-partitioned
+    (unannotated modules keep plain initializers so every non-GSPMD engine
+    sees ordinary unboxed params)."""
+    return nn.with_partitioning(init, spec) if enabled else init
 
 
 class SelfAttention(nn.Module):
@@ -40,14 +56,30 @@ class SelfAttention(nn.Module):
     dropout_rate: float = 0.0   # attention-probability dropout (dense only:
                                 # blockwise ring/ulysses skip it, as flash-
                                 # style attention implementations do)
+    partition_model: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, pad_mask, train: bool = False):
         head_dim = self.hidden // self.heads
-        proj = lambda name: nn.DenseGeneral(  # noqa: E731
-            features=(self.heads, head_dim), dtype=self.dtype, name=name)
-        q, k, v = proj("query")(x), proj("key")(x), proj("value")(x)
+        tp = self.partition_model
+        # column-parallel QKV: kernel (hidden, heads*head_dim) with the packed
+        # output dim sharded — when tp divides heads, the head reshape leaves
+        # each model-device a contiguous slice of heads; otherwise GSPMD
+        # reshards around the reshape (correct, but cross-head tp stops
+        # paying off).  Plain Dense, not DenseGeneral: flax re-traces
+        # DenseGeneral's boxed pre-reshape kernel at apply time, which breaks
+        # under partial-manual shard_map meshes.
+        def proj(name):
+            h = nn.Dense(
+                self.heads * head_dim, dtype=self.dtype, name=name,
+                kernel_init=_part(nn.initializers.lecun_normal(),
+                                  (None, meshlib.MODEL_AXIS), tp),
+                bias_init=_part(nn.initializers.zeros_init(),
+                                (meshlib.MODEL_AXIS,), tp))(x)
+            return h.reshape(h.shape[:-1] + (self.heads, head_dim))
+
+        q, k, v = proj("query"), proj("key"), proj("value")
         if self.attention_impl == "ring":
             out = ring_attention(q, k, v, axis=self.seq_axis, kv_mask=pad_mask)
         elif self.attention_impl == "ulysses":
@@ -61,8 +93,13 @@ class SelfAttention(nn.Module):
                 drop = nn.Dropout(self.dropout_rate, deterministic=not train)
                 prob_fn = lambda p: drop(p)  # noqa: E731
             out = dense_attention(q, k, v, kv_mask=pad_mask, prob_fn=prob_fn)
-        return nn.DenseGeneral(features=self.hidden, axis=(-2, -1),
-                               dtype=self.dtype, name="out")(out)
+        # row-parallel output: contraction over the packed (sharded) head dim
+        # — XLA inserts the single all-reduce of the pair here
+        out = out.reshape(out.shape[:-2] + (self.heads * head_dim,))
+        return nn.Dense(
+            self.hidden, dtype=self.dtype, name="out",
+            kernel_init=_part(nn.initializers.lecun_normal(),
+                              (meshlib.MODEL_AXIS, None), tp))(out)
 
 
 class TransformerLayer(nn.Module):
@@ -72,17 +109,29 @@ class TransformerLayer(nn.Module):
     dropout_rate: float = 0.1
     attention_impl: str = "dense"
     seq_axis: str = "seq"
+    partition_model: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, pad_mask, train: bool = False):
+        tp = self.partition_model
         y = SelfAttention(self.hidden, self.heads, self.attention_impl,
-                          self.seq_axis, self.dropout_rate,
+                          self.seq_axis, self.dropout_rate, tp,
                           self.dtype)(x, pad_mask, train)
         x = nn.LayerNorm(dtype=self.dtype)(x + y)
-        y = nn.Dense(self.ffn, dtype=self.dtype)(x)
+        # Megatron FFN: column-parallel expand, row-parallel contract — the
+        # (B, L, ffn) activation never leaves its model shard
+        y = nn.Dense(
+            self.ffn, dtype=self.dtype,
+            kernel_init=_part(nn.initializers.lecun_normal(),
+                              (None, meshlib.MODEL_AXIS), tp),
+            bias_init=_part(nn.initializers.zeros_init(),
+                            (meshlib.MODEL_AXIS,), tp))(x)
         y = nn.gelu(y)
-        y = nn.Dense(self.hidden, dtype=self.dtype)(y)
+        y = nn.Dense(
+            self.hidden, dtype=self.dtype,
+            kernel_init=_part(nn.initializers.lecun_normal(),
+                              (meshlib.MODEL_AXIS, None), tp))(y)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return nn.LayerNorm(dtype=self.dtype)(x + y)
 
@@ -98,6 +147,7 @@ class BertTinyClassifier(nn.Module):
     dropout_rate: float = 0.1
     attention_impl: str = "dense"
     seq_axis: str = "seq"
+    partition_model: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -117,14 +167,21 @@ class BertTinyClassifier(nn.Module):
             pos = offset + jnp.arange(lq)[None, :]
         else:
             pos = jnp.arange(lq)[None, :]
-        x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype)(token_ids)
+        # vocab-sharded token embedding (Megatron): the vocab dim is the one
+        # that grows; GSPMD renders the sharded gather as masked-lookup+psum
+        x = nn.Embed(
+            self.vocab_size, self.hidden, dtype=self.dtype,
+            embedding_init=_part(nn.linear.default_embed_init,
+                                 (meshlib.MODEL_AXIS, None),
+                                 self.partition_model))(token_ids)
         x = x + nn.Embed(self.max_len, self.hidden, dtype=self.dtype)(pos)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         for _ in range(self.layers):
             x = TransformerLayer(self.hidden, self.heads, self.ffn,
                                  self.dropout_rate, self.attention_impl,
-                                 self.seq_axis, self.dtype)(x, pad_mask, train)
+                                 self.seq_axis, self.partition_model,
+                                 self.dtype)(x, pad_mask, train)
         cls = x[:, 0]  # [CLS]: global position 0
         if seq_parallel:
             # only seq-device 0 holds the real [CLS]; replicate it so the

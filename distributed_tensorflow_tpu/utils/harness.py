@@ -103,8 +103,11 @@ def _setup(config: ExperimentConfig) -> _Experiment:
                          "expert_parallel")
              if getattr(config, f) > 1]
     if len(multi) > 1:
-        raise ValueError(f"{' and '.join(multi)} are mutually exclusive in "
-                         "this release")
+        if set(multi) == {"seq_parallel", "tensor_parallel"}:
+            return _setup_composite(config)
+        raise ValueError(
+            f"{' and '.join(multi)} cannot be combined; composable pair in "
+            f"this release: tensor_parallel × seq_parallel (dp×tp×sp)")
     if config.seq_parallel > 1:
         return _setup_seq_parallel(config)
     if config.tensor_parallel > 1:
@@ -152,21 +155,26 @@ def _global_batch(config: ExperimentConfig, dp: int) -> int:
 
 
 def _split_mesh(config: ExperimentConfig, factor: int, factor_name: str,
-                second_axis: str):
-    """2-D (data, <second_axis>) mesh: factor devices on the second axis,
-    the rest on data.  Shared by the seq- and tensor-parallel setups."""
+                second_axis: str, *more: tuple[int, str]):
+    """(data, <second_axis>, ...) mesh: the named factors take their axes,
+    the remaining devices shard data.  Shared by every model-parallel setup."""
     import jax as _jax
 
     if config.engine not in ("sync", "allreduce"):
         raise ValueError(
-            f"{factor_name}>1 supports sync semantics only, got "
+            f"{factor_name} supports sync semantics only, got "
             f"engine='{config.engine}'")
+    factors = [(factor, second_axis), *more]
     total = config.n_devices or len(_jax.devices())
-    if total % factor != 0:
-        raise ValueError(f"n_devices {total} not divisible by {factor_name} {factor}")
-    dp = total // factor
-    mesh = meshlib.create_mesh(total, shape=(dp, factor),
-                               axis_names=(meshlib.DATA_AXIS, second_axis))
+    prod = 1
+    for f, _ in factors:
+        prod *= f
+    if total % prod != 0:
+        raise ValueError(f"n_devices {total} not divisible by {factor_name} {prod}")
+    dp = total // prod
+    mesh = meshlib.create_mesh(
+        total, shape=(dp, *[f for f, _ in factors]),
+        axis_names=(meshlib.DATA_AXIS, *[a for _, a in factors]))
     return mesh, dp
 
 
@@ -183,22 +191,8 @@ def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
     mesh, dp = _split_mesh(config, config.seq_parallel, "seq_parallel",
                            meshlib.SEQ_AXIS)
     train_ds, test_ds = _load_data(config)
-    if not np.issubdtype(train_ds.x.dtype, np.integer):
-        raise ValueError(
-            f"seq_parallel needs a token dataset (integer ids), got "
-            f"--dataset {config.dataset} with dtype {train_ds.x.dtype}; "
-            f"use --dataset glue_synth")
-    if config.model_fn is not None:
-        model = config.model_fn()
-    elif config.model in _SEQUENCE_MODELS:
-        model = modellib.create_model(
-            config.model, num_classes=train_ds.num_classes,
-            attention_impl=config.attention_impl, dtype=config.dtype)
-    else:
-        raise ValueError(
-            f"seq_parallel needs a sequence model ({'/'.join(_SEQUENCE_MODELS)}), "
-            f"got --model {config.model}; pass model_fn for a custom model "
-            f"with attention_impl='ring'|'ulysses'")
+    model = _sequence_model(config, train_ds, "seq_parallel",
+                            attention_impl=config.attention_impl)
 
     engine = SeqParallelEngine(model, mesh=mesh,
                                learning_rate=config.learning_rate)
@@ -214,19 +208,58 @@ def _setup_tensor_parallel(config: ExperimentConfig) -> _Experiment:
     mesh, dp = _split_mesh(config, config.tensor_parallel, "tensor_parallel",
                            meshlib.MODEL_AXIS)
     train_ds, test_ds = _load_data(config)
-    if config.model_fn is not None:
-        model = config.model_fn()
-    elif config.model in ("mlp", "tp_mlp", "mnist_mlp"):
+    if config.model_fn is None and config.model in ("mlp", "tp_mlp",
+                                                    "mnist_mlp"):
         model = TPMLP(num_classes=train_ds.num_classes,
                       dtype=modellib.resolve_dtype(config.dtype))
     else:
-        raise ValueError(
-            f"tensor_parallel currently ships TP annotations for the MLP "
-            f"only (got --model {config.model}); pass model_fn with "
-            f"flax with_partitioning annotations for custom TP models")
+        model = _sequence_model(config, train_ds, "tensor_parallel",
+                                partition_model=True, attention_impl="dense")
 
     engine = TensorParallelEngine(model, mesh=mesh,
                                   learning_rate=config.learning_rate)
+    return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
+                       engine=engine, global_batch=_global_batch(config, dp))
+
+
+def _require_token_data(train_ds, config: ExperimentConfig, mode: str) -> None:
+    if not np.issubdtype(train_ds.x.dtype, np.integer):
+        raise ValueError(
+            f"{mode} with a sequence model needs a token dataset (integer "
+            f"ids), got --dataset {config.dataset} with dtype "
+            f"{train_ds.x.dtype}; use --dataset glue_synth")
+
+
+def _sequence_model(config: ExperimentConfig, train_ds, mode: str, **kw):
+    """Resolve a sequence model for a model-parallel mode: user ``model_fn``
+    wins as-is; registered sequence models get the mode's sharding kwargs;
+    anything else is an error (non-sequence models carry no seq/TP layout)."""
+    if config.model_fn is not None:
+        return config.model_fn()
+    if config.model in _SEQUENCE_MODELS:
+        _require_token_data(train_ds, config, mode)
+        return modellib.create_model(
+            config.model, num_classes=train_ds.num_classes,
+            dtype=config.dtype, **kw)
+    raise ValueError(
+        f"{mode} needs a sequence model ({'/'.join(_SEQUENCE_MODELS)}), got "
+        f"--model {config.model}; pass model_fn for a custom model")
+
+
+def _setup_composite(config: ExperimentConfig) -> _Experiment:
+    """dp×tp×sp composition: 3-D (data, model, seq) mesh, GSPMD tensor
+    parallelism + manual-seq ring/Ulysses attention (engines/composite.py)."""
+    from distributed_tensorflow_tpu.engines.composite import CompositeEngine
+
+    mesh, dp = _split_mesh(config, config.tensor_parallel,
+                           "tensor_parallel×seq_parallel", meshlib.MODEL_AXIS,
+                           (config.seq_parallel, meshlib.SEQ_AXIS))
+    train_ds, test_ds = _load_data(config)
+    model = _sequence_model(config, train_ds, "tensor_parallel×seq_parallel",
+                            partition_model=True,
+                            attention_impl=config.attention_impl)
+    engine = CompositeEngine(model, mesh=mesh,
+                             learning_rate=config.learning_rate)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
 
@@ -382,7 +415,9 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     ev = trainer.evaluate(test_ds, batch_size=config.eval_batch)
     sink.results(ev["accuracy"], loss=ev["loss"])
 
-    if config.seq_parallel > 1:
+    if config.seq_parallel > 1 and config.tensor_parallel > 1:
+        engine_name = f"composite[dp*tp*sp,{config.attention_impl}]"
+    elif config.seq_parallel > 1:
         engine_name = f"seq_parallel[{config.attention_impl}]"
     elif config.tensor_parallel > 1:
         engine_name = "tensor_parallel"

@@ -77,7 +77,7 @@ def test_expert_parallel_trains(mesh8):
     state = eng.init_state(jax.random.key(0), x)
 
     # expert weights actually sharded over the expert axis
-    w1 = state.params["MoELayer_0"]["w1"].value
+    w1 = state.params["MoELayer_0"]["w1"]
     spec = w1.sharding.spec
     assert spec[0] == meshlib.EXPERT_AXIS
 

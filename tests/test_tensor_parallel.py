@@ -79,3 +79,64 @@ def test_tp_trains_and_evaluates():
 def test_tp_mesh_validation():
     with pytest.raises(ValueError):
         TensorParallelEngine(TPMLP(), mesh=meshlib.create_mesh(8))
+
+
+def tiny_tp_bert(tp=True):
+    return create_model(
+        "bert_tiny", num_classes=2, vocab_size=128, hidden=32, layers=2,
+        heads=2, ffn=64, max_len=32, dropout_rate=0.0, partition_model=tp)
+
+
+def test_tp_bert_matches_single_device():
+    """BERT with Megatron partition_model annotations: (data=2, model=4)
+    must equal 1-device training (VERDICT r1 #3 acceptance)."""
+    rnd = np.random.default_rng(3)
+    x = rnd.integers(1, 128, (32, 16)).astype(np.int32)
+    y = (np.arange(32) % 2).astype(np.int32)
+
+    eng1 = TensorParallelEngine(tiny_tp_bert(), optimizer=optax.sgd(0.1),
+                                mesh=tp_mesh(1, 1))
+    s1 = eng1.init_state(jax.random.key(0), x)
+    eng8 = TensorParallelEngine(tiny_tp_bert(), optimizer=optax.sgd(0.1),
+                                mesh=tp_mesh(2, 4))
+    s8 = eng8.init_state(jax.random.key(0), x)
+
+    for _ in range(2):
+        s1, m1 = eng1.step(s1, *eng1.shard_batch(x, y))
+        s8, m8 = eng8.step(s8, *eng8.shard_batch(x, y))
+
+    for a, b in zip(jax.tree.leaves(jax.device_get(s1.params)),
+                    jax.tree.leaves(jax.device_get(s8.params))):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-3)
+    assert float(m1["loss"]) == pytest.approx(float(m8["loss"]), abs=1e-5)
+
+
+def test_tp_bert_params_sharded():
+    eng = TensorParallelEngine(tiny_tp_bert(), mesh=tp_mesh(2, 4))
+    x = np.ones((8, 16), np.int32)
+    state = eng.init_state(jax.random.key(0), x)
+    flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    sharded = [jax.tree_util.keystr(p) for p, l in flat
+               if "model" in str(l.sharding.spec)]
+    # QKV col-parallel, attention out row-parallel, FFN both, vocab embed
+    for want in ("query", "key", "value", "out", "Dense_0", "Dense_1",
+                 "Embed_0"):
+        assert any(want in n for n in sharded), (want, sharded)
+
+
+def test_tp_bert_harness_run():
+    """`--model bert_tiny -tp 4` accepted by the harness (whitelist dropped)."""
+    from distributed_tensorflow_tpu.data.loaders import load_text_dataset
+    from distributed_tensorflow_tpu.utils.harness import ExperimentConfig, run
+
+    def dataset_fn(batch_size, type="train", **kw):
+        return load_text_dataset(seq_len=16, vocab_size=128, n_train=128,
+                                 n_test=64, split=type)
+
+    summary = run(ExperimentConfig(
+        engine="sync", model="bert_tiny", dataset="glue_synth",
+        n_devices=8, tensor_parallel=4, batch_size=16, epochs=1, log_every=0,
+        model_fn=lambda: tiny_tp_bert(), dataset_fn=dataset_fn))
+    assert summary["engine"] == "tensor_parallel"
+    assert summary["tensor_parallel"] == 4
+    assert np.isfinite(summary["test_loss"])
