@@ -93,6 +93,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(GPipe-style microbatched pipeline)")
     p.add_argument("--microbatches", type=int, default=4,
                    help="pipeline microbatches per step (bubble = (S-1)/(M+S-1))")
+    p.add_argument("--pipeline-hidden", type=int, default=128,
+                   help="pipeline stage hidden width")
     p.add_argument("-ep", "--expert-parallel", type=int, default=1,
                    help="shard MoE experts over this many devices "
                         "(GShard/Switch-style EP; --model moe)")
@@ -188,6 +190,7 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         tensor_parallel=args.tensor_parallel,
         pipeline_parallel=args.pipeline_parallel,
         microbatches=args.microbatches,
+        pipeline_hidden=args.pipeline_hidden,
         expert_parallel=args.expert_parallel,
         num_experts=args.num_experts,
         aux_weight=args.aux_weight,

@@ -103,6 +103,15 @@ class PipelineEngine(Engine):
     ``mesh`` must have axes ('data', 'pipe'); the number of stages S is the
     pipe-axis size.  ``microbatches`` (M) must divide the per-data-shard
     batch.  Throughput approaches M/(M+S-1) of bubble-free as M grows.
+
+    ``stages`` plugs in custom (embed, block, head) modules — e.g.
+    ``models.bert.bert_pipeline_stages`` to pipeline a transformer encoder.
+    Contract: ``block(carry) -> carry`` where ``carry`` is whatever pytree
+    ``embed(x)`` returns (it rides the pipe-axis ppermute between stages, so
+    keep it activation-sized), every stage has identical parameter structure
+    (they are stacked and sharded P('pipe')), and all three modules are
+    deterministic — the schedule re-applies embed/head every tick, so rng-
+    consuming ops (dropout) would draw inconsistent masks across ticks.
     """
 
     def __init__(
@@ -115,13 +124,18 @@ class PipelineEngine(Engine):
         learning_rate: float = 1e-3,
         expansion: int = 2,
         dtype: jnp.dtype = jnp.float32,
+        stages: tuple[nn.Module, nn.Module, nn.Module] | None = None,
     ):
         if mesh is None or set(mesh.axis_names) != {meshlib.DATA_AXIS,
                                                     meshlib.PIPE_AXIS}:
             raise ValueError("PipelineEngine requires a ('data','pipe') mesh")
-        self.embed = PipelineEmbed(hidden=hidden, dtype=dtype)
-        self.block = PipelineBlock(hidden=hidden, expansion=expansion, dtype=dtype)
-        self.head = PipelineHead(num_classes=num_classes, dtype=dtype)
+        if stages is not None:
+            self.embed, self.block, self.head = stages
+        else:
+            self.embed = PipelineEmbed(hidden=hidden, dtype=dtype)
+            self.block = PipelineBlock(hidden=hidden, expansion=expansion,
+                                       dtype=dtype)
+            self.head = PipelineHead(num_classes=num_classes, dtype=dtype)
         self.n_stages = mesh.shape[meshlib.PIPE_AXIS]
         self.microbatches = microbatches
         super().__init__(model=None, optimizer=optimizer, mesh=mesh,
@@ -182,8 +196,10 @@ class PipelineEngine(Engine):
                     xi = lax.dynamic_index_in_dim(
                         micro_x, jnp.clip(i, 0, M - 1), keepdims=False)
                     h_src = embed.apply({"params": params["embed"]}, xi)
-                    h_src = lax.pcast(h_src, pipe_axis, to="varying")
-                    h_in = jnp.where(stage == 0, h_src, buf)
+                    h_src = jax.tree.map(
+                        lambda a: lax.pcast(a, pipe_axis, to="varying"), h_src)
+                    h_in = jax.tree.map(
+                        lambda s, b: jnp.where(stage == 0, s, b), h_src, buf)
                     h_out = block.apply({"params": blocks_local}, h_in)
                     # last stage drains microbatch i-(S-1)
                     oi = i - (S - 1)
@@ -196,12 +212,20 @@ class PipelineEngine(Engine):
                     loss_i = cross_entropy(logits, yi).mean() * w
                     acc_i = (logits.argmax(-1) == yi).mean(
                         ).astype(jnp.float32) * w
-                    buf_next = lax.ppermute(h_out, axis_name=pipe_axis,
-                                            perm=perm)
+                    buf_next = jax.tree.map(
+                        lambda a: lax.ppermute(a, axis_name=pipe_axis,
+                                               perm=perm), h_out)
                     return buf_next, (loss_i, acc_i, w)
 
-                buf0 = jnp.zeros((mb, block.hidden), jnp.float32)
-                buf0 = lax.pcast(buf0, (data_axis, pipe_axis), to="varying")
+                # buffer shape/dtype comes from the embed output itself, so
+                # any activation pytree (arrays, (h, mask) tuples, ...) works
+                h0 = jax.eval_shape(
+                    lambda p, a: embed.apply({"params": p}, a),
+                    params["embed"], micro_x[0])
+                buf0 = jax.tree.map(
+                    lambda a: lax.pcast(jnp.zeros(a.shape, a.dtype),
+                                        (data_axis, pipe_axis), to="varying"),
+                    h0)
                 _, (losses, accs, ws) = lax.scan(
                     tick, buf0, jnp.arange(M + S - 1))
                 # nonzero only on the last stage; scale so the implicit psum

@@ -136,6 +136,21 @@ class TransformerLayer(nn.Module):
         return nn.LayerNorm(dtype=self.dtype)(x + y)
 
 
+class BertPooler(nn.Module):
+    """[CLS] readout: tanh pooler → classifier logits (f32 for the softmax).
+    Shared by the monolithic classifier and the pipeline head."""
+
+    num_classes: int = 2
+    hidden: int = 128
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, cls):
+        cls = nn.tanh(nn.Dense(self.hidden, dtype=self.dtype)(cls))
+        logits = nn.Dense(self.num_classes, dtype=self.dtype)(cls)
+        return logits.astype(jnp.float32)
+
+
 class BertTinyClassifier(nn.Module):
     num_classes: int = 2
     vocab_size: int = 8192
@@ -187,6 +202,90 @@ class BertTinyClassifier(nn.Module):
             # only seq-device 0 holds the real [CLS]; replicate it so the
             # head computes identically on every seq device
             cls = coll.broadcast_from(cls, self.seq_axis, src=0)
-        cls = nn.tanh(nn.Dense(self.hidden, dtype=self.dtype)(cls))
-        logits = nn.Dense(self.num_classes, dtype=self.dtype)(cls)
-        return logits.astype(jnp.float32)
+        return BertPooler(self.num_classes, self.hidden, self.dtype)(cls)
+
+
+# --------------------------------------------------------------------------
+# GPipe stage modules (engines/pipeline.py `stages=` plug-in): the encoder
+# splits into embed → S identical TransformerLayer stages → [CLS] head.  The
+# pipeline carry is (hidden_states, pad_mask) — the mask must travel with the
+# activations because later stages never see the token ids.  Deterministic by
+# construction (no dropout): the GPipe schedule re-applies embed/head every
+# tick, so rng-consuming ops would draw inconsistent masks across ticks.
+# --------------------------------------------------------------------------
+
+
+class BertPipeEmbed(nn.Module):
+    """Input stage: token + position embeddings → (hidden, pad_mask) carry."""
+
+    vocab_size: int = 8192
+    hidden: int = 128
+    max_len: int = 512
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, token_ids):
+        pad_mask = (token_ids > 0).astype(self.dtype)
+        if token_ids.shape[1] > self.max_len:
+            raise ValueError(
+                f"sequence length {token_ids.shape[1]} exceeds "
+                f"max_len={self.max_len}")
+        pos = jnp.arange(token_ids.shape[1])[None, :]
+        x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype)(token_ids)
+        x = x + nn.Embed(self.max_len, self.hidden, dtype=self.dtype)(pos)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return x, pad_mask
+
+
+class BertPipeBlock(nn.Module):
+    """One pipeline stage: ``layers_per_stage`` transformer layers
+    (hidden-preserving, so stages stack and shard P('pipe'))."""
+
+    hidden: int = 128
+    heads: int = 2
+    ffn: int = 512
+    layers_per_stage: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry):
+        x, pad_mask = carry
+        for _ in range(self.layers_per_stage):
+            x = TransformerLayer(self.hidden, self.heads, self.ffn,
+                                 dropout_rate=0.0, attention_impl="dense",
+                                 dtype=self.dtype)(x, pad_mask)
+        return x, pad_mask
+
+
+class BertPipeHead(nn.Module):
+    """Output stage: the shared [CLS] pooler over the carry's activations."""
+
+    num_classes: int = 2
+    hidden: int = 128
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry):
+        x, _ = carry
+        return BertPooler(self.num_classes, self.hidden, self.dtype)(x[:, 0])
+
+
+def bert_pipeline_stages(
+    num_classes: int = 2,
+    vocab_size: int = 8192,
+    hidden: int = 128,
+    heads: int = 2,
+    ffn: int = 512,
+    max_len: int = 512,
+    layers_per_stage: int = 1,
+    dtype: jnp.dtype = jnp.float32,
+):
+    """(embed, block, head) for ``PipelineEngine(stages=...)``: a BERT
+    encoder of depth ``pipe_axis_size × layers_per_stage``."""
+    return (
+        BertPipeEmbed(vocab_size=vocab_size, hidden=hidden, max_len=max_len,
+                      dtype=dtype),
+        BertPipeBlock(hidden=hidden, heads=heads, ffn=ffn,
+                      layers_per_stage=layers_per_stage, dtype=dtype),
+        BertPipeHead(num_classes=num_classes, hidden=hidden, dtype=dtype),
+    )

@@ -265,19 +265,35 @@ def _setup_composite(config: ExperimentConfig) -> _Experiment:
 
 
 def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
-    """GPipe mode: 2-D (data, pipe) mesh; the engine owns its own
-    embed → stages → head model (stage-stacked params)."""
+    """GPipe mode: 2-D (data, pipe) mesh.  The engine stacks stage params
+    over 'pipe'; --model picks the stage family — the built-in MLP stages or
+    a BERT encoder split layer-per-stage (models/bert.py
+    bert_pipeline_stages)."""
     from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
 
     mesh, dp = _split_mesh(config, config.pipeline_parallel,
                            "pipeline_parallel", meshlib.PIPE_AXIS)
     train_ds, test_ds = _load_data(config)
-    if config.model_fn is not None or config.model not in (
+    stages = None
+    if config.model in _SEQUENCE_MODELS and config.model_fn is None:
+        from distributed_tensorflow_tpu.models.bert import bert_pipeline_stages
+
+        _require_token_data(train_ds, config, "pipeline_parallel")
+        # vocab must cover BOTH splits: nn.Embed silently clamps
+        # out-of-range ids, which would skew eval on unseen test tokens
+        stages = bert_pipeline_stages(
+            num_classes=train_ds.num_classes,
+            vocab_size=int(max(train_ds.x.max(), test_ds.x.max())) + 1,
+            hidden=config.pipeline_hidden,
+            max_len=train_ds.x.shape[1],
+            dtype=modellib.resolve_dtype(config.dtype))
+    elif config.model_fn is not None or config.model not in (
             "mlp", "mnist_mlp", "pipeline_mlp"):
         raise ValueError(
-            f"pipeline_parallel builds its own stage-stacked MLP model "
-            f"(got --model {config.model}); custom models need "
-            f"hidden-preserving stages — subclass PipelineEngine")
+            f"pipeline_parallel ships stages for mlp and "
+            f"{'/'.join(_SEQUENCE_MODELS)} (got --model {config.model}); "
+            f"custom models pass stages=(embed, block, head) to "
+            f"PipelineEngine directly")
     if (_global_batch(config, dp) // dp) % config.microbatches:
         raise ValueError(
             f"per-data-shard batch {_global_batch(config, dp) // dp} not "
@@ -286,7 +302,8 @@ def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
                             hidden=config.pipeline_hidden,
                             microbatches=config.microbatches, mesh=mesh,
                             learning_rate=config.learning_rate,
-                            dtype=modellib.resolve_dtype(config.dtype))
+                            dtype=modellib.resolve_dtype(config.dtype),
+                            stages=stages)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
 

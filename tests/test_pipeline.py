@@ -118,3 +118,73 @@ def test_evaluate_runs_on_pipe_sharded_params():
 def test_requires_data_pipe_mesh():
     with pytest.raises(ValueError, match="data.*pipe|pipe"):
         PipelineEngine(mesh=meshlib.create_mesh(8))
+
+
+# ----------------------------------------------------------- BERT stages
+
+
+def _bert_engine(dp=2, pp=4, m=4, lr=0.1):
+    from distributed_tensorflow_tpu.models.bert import bert_pipeline_stages
+
+    return PipelineEngine(
+        microbatches=m, mesh=_mesh(dp, pp), optimizer=optax.sgd(lr),
+        stages=bert_pipeline_stages(num_classes=2, vocab_size=128, hidden=32,
+                                    heads=2, ffn=64, max_len=16))
+
+
+def _tokens(n=16, seed=0):
+    rnd = np.random.default_rng(seed)
+    x = rnd.integers(1, 128, (n, 16)).astype(np.int32)
+    y = (np.arange(n) % 2).astype(np.int32)
+    return x, y
+
+
+def test_bert_pipeline_matches_sequential_forward():
+    """Pipelined BERT step loss == sequential-forward loss (VERDICT r1 #5:
+    pipelining a real registered model, not the built-in MLP)."""
+    eng = _bert_engine(lr=0.0)
+    x, y = _tokens()
+    state = eng.init_state(jax.random.key(0), x)
+    state, metrics = eng.step(state, *eng.shard_batch(x, y))
+    params = jax.device_get(state.params)
+    logits = eng._sequential_logits(params, x)
+    ref = float(cross_entropy(logits, jnp.asarray(y)).mean())
+    assert abs(float(metrics["loss"]) - ref) < 1e-5
+
+
+def test_bert_pipeline_gradients_match_sequential_model():
+    lr = 0.1
+    eng = _bert_engine(lr=lr)
+    x, y = _tokens()
+    state = eng.init_state(jax.random.key(0), x)
+    before = jax.device_get(state.params)
+    state, _ = eng.step(state, *eng.shard_batch(x, y))
+    after = jax.device_get(state.params)
+
+    def ref_loss(params):
+        logits = eng._sequential_logits(params, x)
+        return cross_entropy(logits, jnp.asarray(y)).mean()
+
+    grads = jax.grad(ref_loss)(before)
+    expected = jax.tree.map(lambda p, g: p - lr * g, before, grads)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(a, e, atol=2e-5, rtol=1e-4),
+        after, expected)
+
+
+def test_bert_pipeline_harness_run():
+    """`-pp 4 --model bert_tiny` accepted end-to-end by the harness."""
+    from distributed_tensorflow_tpu.data.loaders import load_text_dataset
+    from distributed_tensorflow_tpu.utils.harness import ExperimentConfig, run
+
+    def dataset_fn(batch_size, type="train", **kw):
+        return load_text_dataset(seq_len=16, vocab_size=128, n_train=128,
+                                 n_test=64, split=type)
+
+    summary = run(ExperimentConfig(
+        engine="sync", model="bert_tiny", dataset="glue_synth",
+        n_devices=8, pipeline_parallel=4, microbatches=2, pipeline_hidden=32,
+        batch_size=8, epochs=1, log_every=0, dataset_fn=dataset_fn))
+    assert summary["engine"] == "pipeline_parallel"
+    assert summary["pipeline_parallel"] == 4
+    assert np.isfinite(summary["test_loss"])
