@@ -103,6 +103,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--aux-weight", type=float, default=0.01,
                    help="MoE load-balance auxiliary loss weight")
     p.add_argument("--result-path", default=None, help="JSONL event sink path")
+    p.add_argument("--supervisor", default=None, metavar="HOST[:PORT]",
+                   help="report the reference's start/done/results event "
+                        "triple to an external supervisor socket (reference "
+                        "server.py:121-124; port defaults to 4000).  Distinct "
+                        "from -sa, which is the multi-host coordinator")
     p.add_argument("--log-every", type=int, default=50)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--checkpoint-dir", default=None,
@@ -153,7 +158,15 @@ def main(argv: list[str] | None = None, *, model_fn=None,
     """CLI entry.  ``model_fn``/``dataset_fn`` are the reference's user
     plug-in contract (reference README.md:12: "edit model_fn/dataset_fn in
     initializer.py"): when provided they override --model/--dataset."""
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if (args.task_type is None) != (args.server_address is None):
+        # the reference dispatches on task_type alone (reference
+        # initializer.py:147-155); silently running single-process when one
+        # half of the pair is missing would mask a misconfigured pod
+        parser.error("-tt/--task_type and -sa/--server_address must be "
+                     "given together for a multi-host run")
 
     if args.task_type is not None and args.server_address is not None:
         # multi-host pod: same SPMD program on every host, coordinated by
@@ -184,7 +197,7 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         seed=args.seed,
         log_every=args.log_every,
         result_path=args.result_path,
-        supervisor_address=None,
+        supervisor_address=args.supervisor,
         seq_parallel=args.seq_parallel,
         attention_impl=args.attention,
         tensor_parallel=args.tensor_parallel,

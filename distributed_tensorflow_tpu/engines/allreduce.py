@@ -95,9 +95,11 @@ class Trainer:
                     # every step would sync the host into the pipeline that
                     # max_in_flight deliberately keeps async
                     floats = {k: float(v) for k, v in metrics.items()}
+                    # log first: the diverging step's NaN record must reach
+                    # the sink before check_finite raises
+                    metrics_logger.log(gstep, **floats)
                     if nan_guard:
                         check_finite(floats, gstep)
-                    metrics_logger.log(gstep, **floats)
                 if checkpoint_manager is not None and checkpoint_every and \
                         gstep % checkpoint_every == 0:
                     jax.block_until_ready(self.state)

@@ -12,6 +12,16 @@ mesh every ``sync_every`` steps via `pmean`.
 Layout: the whole TrainState is *stacked* — every leaf gains a leading
 device axis sharded over ``data``, so device i owns row i.  Inside shard_map
 each device sees a size-1 leading axis which we strip/restore.
+
+Memory scaling (design note): local SGD *inherently* keeps one divergent
+parameter+optimizer copy per device — aggregate state is O(n_devices) ×
+model size by definition of the algorithm, not an implementation artifact.
+Per-device HBM holds exactly ONE copy (the stack is sharded row-wise over
+``data``; init materializes each row directly on its own device — verified
+by tests/test_engines.py::test_async_state_sharded_one_copy_per_device).
+For models near single-device HBM capacity, local SGD is the wrong tool:
+use the sync/allreduce engines (replicated params, sharded batch) or the
+GSPMD engines (sharded params).
 """
 
 from __future__ import annotations
@@ -34,15 +44,23 @@ class AsyncLocalEngine(Engine):
 
     # state is per-device: every leaf stacked along a leading device axis
     def init_state(self, rng, sample_x) -> TrainState:
-        params = self.model.init(rng, jnp.asarray(sample_x[:1]), train=False)["params"]
-        opt_state = self.tx.init(params)
-        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                           opt_state=opt_state, rng=rng)
+        x = jnp.asarray(sample_x[:1])
         n = self.n_devices
-        stacked = jax.tree.map(
-            lambda a: jnp.broadcast_to(a, (n, *jnp.shape(a))), state)
-        return meshlib.state_to_global(stacked,
-                                       meshlib.per_device_sharding(self.mesh))
+
+        def init_fn(rng):
+            params = self.model.init(rng, x, train=False)["params"]
+            opt_state = self.tx.init(params)
+            state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                               opt_state=opt_state, rng=rng)
+            return jax.tree.map(
+                lambda a: jnp.broadcast_to(a, (n, *jnp.shape(a))), state)
+
+        # jit with out_shardings: each stacked row materializes directly on
+        # its own device — a plain broadcast_to would build the full n× stack
+        # on one device before resharding
+        return jax.jit(
+            init_fn,
+            out_shardings=meshlib.per_device_sharding(self.mesh))(rng)
 
     def _build_step(self):
         loss_fn = make_loss_fn(self.model.apply)

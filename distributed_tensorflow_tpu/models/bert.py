@@ -136,6 +136,30 @@ class TransformerLayer(nn.Module):
         return nn.LayerNorm(dtype=self.dtype)(x + y)
 
 
+class BertEmbeddings(nn.Module):
+    """Token + position embeddings → LayerNorm.  Shared by the monolithic
+    classifier and the pipeline embed stage; callers supply the position ids
+    (seq-parallel blocks pass offset positions) and own the max_len check."""
+
+    vocab_size: int = 8192
+    hidden: int = 128
+    max_len: int = 512
+    partition_model: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, token_ids, pos):
+        # vocab-sharded token embedding (Megatron): the vocab dim is the one
+        # that grows; GSPMD renders the sharded gather as masked-lookup+psum
+        x = nn.Embed(
+            self.vocab_size, self.hidden, dtype=self.dtype,
+            embedding_init=_part(nn.linear.default_embed_init,
+                                 (meshlib.MODEL_AXIS, None),
+                                 self.partition_model))(token_ids)
+        x = x + nn.Embed(self.max_len, self.hidden, dtype=self.dtype)(pos)
+        return nn.LayerNorm(dtype=self.dtype)(x)
+
+
 class BertPooler(nn.Module):
     """[CLS] readout: tanh pooler → classifier logits (f32 for the softmax).
     Shared by the monolithic classifier and the pipeline head."""
@@ -182,15 +206,8 @@ class BertTinyClassifier(nn.Module):
             pos = offset + jnp.arange(lq)[None, :]
         else:
             pos = jnp.arange(lq)[None, :]
-        # vocab-sharded token embedding (Megatron): the vocab dim is the one
-        # that grows; GSPMD renders the sharded gather as masked-lookup+psum
-        x = nn.Embed(
-            self.vocab_size, self.hidden, dtype=self.dtype,
-            embedding_init=_part(nn.linear.default_embed_init,
-                                 (meshlib.MODEL_AXIS, None),
-                                 self.partition_model))(token_ids)
-        x = x + nn.Embed(self.max_len, self.hidden, dtype=self.dtype)(pos)
-        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = BertEmbeddings(self.vocab_size, self.hidden, self.max_len,
+                           self.partition_model, self.dtype)(token_ids, pos)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         for _ in range(self.layers):
             x = TransformerLayer(self.hidden, self.heads, self.ffn,
@@ -231,9 +248,8 @@ class BertPipeEmbed(nn.Module):
                 f"sequence length {token_ids.shape[1]} exceeds "
                 f"max_len={self.max_len}")
         pos = jnp.arange(token_ids.shape[1])[None, :]
-        x = nn.Embed(self.vocab_size, self.hidden, dtype=self.dtype)(token_ids)
-        x = x + nn.Embed(self.max_len, self.hidden, dtype=self.dtype)(pos)
-        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = BertEmbeddings(self.vocab_size, self.hidden, self.max_len,
+                           dtype=self.dtype)(token_ids, pos)
         return x, pad_mask
 
 

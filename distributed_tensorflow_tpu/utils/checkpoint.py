@@ -37,6 +37,20 @@ def _rekey(template, tree):
         template, tree)
 
 
+def _host_template(template):
+    """Host-side restore template (structure + shape + dtype).  Single
+    process: the real values via device_get.  Multi-process: shape/dtype
+    zeros — device_get cannot read non-addressable shards, and Orbax only
+    needs the structure to restore into."""
+    t = _unkey(template)
+    if jax.process_count() == 1:
+        return jax.device_get(t)
+    import numpy as np
+
+    return jax.tree.map(
+        lambda a: np.zeros(a.shape, a.dtype) if hasattr(a, "shape") else a, t)
+
+
 class CheckpointManager:
     """Step-numbered checkpoints under ``directory`` with retention."""
 
@@ -49,10 +63,33 @@ class CheckpointManager:
     # ------------------------------------------------------------------ save
     def save(self, state: Any, step: int | None = None) -> Path:
         if step is None:
-            step = int(jax.device_get(state.step).max())
+            s = state.step
+            if getattr(s, "is_fully_addressable", True):
+                step = int(jax.device_get(s).max())
+            else:
+                # device_get rejects non-addressable shards (stacked async
+                # state on multi-process meshes); all rows carry the same
+                # step, so local shards suffice
+                import numpy as np
+
+                step = int(max(np.asarray(sh.data).max()
+                               for sh in s.addressable_shards))
         path = self.directory / f"step_{step}"
-        self._ckptr.save(path, jax.device_get(_unkey(state)), force=True)
-        self._retain()
+        state = _unkey(state)
+        if jax.process_count() > 1:
+            # device_get cannot read non-addressable shards (tp/pp/ep state
+            # on multi-process meshes): gather full host copies everywhere,
+            # then let exactly one process write the shared directory
+            from jax.experimental import multihost_utils
+
+            host_state = multihost_utils.process_allgather(state)
+            if jax.process_index() == 0:
+                self._ckptr.save(path, host_state, force=True)
+                self._retain()
+            multihost_utils.sync_global_devices(f"ckpt_save_{step}")
+        else:
+            self._ckptr.save(path, jax.device_get(state), force=True)
+            self._retain()
         return path
 
     def _retain(self) -> None:
@@ -84,8 +121,18 @@ class CheckpointManager:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
         restored = self._ckptr.restore(
             self.directory / f"step_{step}",
-            item=jax.device_get(_unkey(template)))
+            item=_host_template(template))
         restored = _rekey(template, restored)
+        if jax.process_count() > 1:
+            # device_put rejects non-addressable shardings; the jit-identity
+            # placement (mesh.state_to_global) reshards host-replicated
+            # values onto the global mesh instead
+            from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+            shardings = jax.tree.map(
+                lambda t: t.sharding if hasattr(t, "sharding") else None,
+                template)
+            return meshlib.state_to_global(restored, shardings)
         # re-place on device with the template's shardings
         return jax.tree.map(
             lambda t, r: jax.device_put(r, t.sharding)

@@ -120,11 +120,7 @@ def _setup(config: ExperimentConfig) -> _Experiment:
     n = mesh.shape[meshlib.DATA_AXIS]
 
     train_ds, test_ds = _load_data(config)
-    if config.model_fn is not None:
-        model = config.model_fn()
-    else:
-        model = modellib.create_model(config.model, num_classes=train_ds.num_classes,
-                                      dtype=config.dtype)
+    model = _resolve_model(config, train_ds.num_classes)
 
     # reference -b is the PER-WORKER batch (reference client.py:64 feeds each
     # worker's shard with batch_size b); global batch = b × n matches its
@@ -139,6 +135,41 @@ def _setup(config: ExperimentConfig) -> _Experiment:
     engine = create_engine(config.engine, model, **engine_kw)
     return _Experiment(mesh=mesh, n=n, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=global_batch)
+
+
+def _resolve_model(config: ExperimentConfig, num_classes: int):
+    """Model for the data-parallel engines: plug-in ``model_fn`` wins (and
+    owns its dtype — warn if --dtype would be silently ignored); registered
+    models get ``dtype`` only if their Module accepts it."""
+    if config.model_fn is not None:
+        if (modellib.resolve_dtype(config.dtype)
+                is not modellib.resolve_dtype("float32")):
+            import warnings
+
+            warnings.warn(
+                f"--dtype {config.dtype} is ignored for plug-in model_fn "
+                f"models; the model_fn owns its dtype", stacklevel=2)
+        return config.model_fn()
+    try:
+        return modellib.create_model(config.model, num_classes=num_classes,
+                                     dtype=config.dtype)
+    except TypeError as dtype_err:
+        # user-register()ed Modules may not declare a dtype field; probe by
+        # retrying WITHOUT dtype — if that also fails, the factory has a
+        # genuine bug and the original error must surface, not a misleading
+        # dtype message
+        try:
+            model = modellib.create_model(config.model,
+                                          num_classes=num_classes)
+        except TypeError:
+            raise dtype_err
+        if (modellib.resolve_dtype(config.dtype)
+                is not modellib.resolve_dtype("float32")):
+            raise ValueError(
+                f"model '{config.model}' does not accept a dtype field; "
+                f"drop --dtype {config.dtype} or add dtype support to the "
+                f"model") from dtype_err
+        return model
 
 
 def _load_data(config: ExperimentConfig):
@@ -364,8 +395,13 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     n, train_ds, test_ds = ex.n, ex.train_ds, ex.test_ds
     global_batch = ex.global_batch
 
+    # in a multi-host pod only process 0 reports — N processes each emitting
+    # the start/done/results triple would corrupt an external supervisor's
+    # accounting (the reference has exactly one reporting server)
+    supervisor = (config.supervisor_address
+                  if jax.process_index() == 0 else None)
     sink = ResultSink(config.result_path, echo=False,
-                      supervisor_address=config.supervisor_address)
+                      supervisor_address=supervisor)
     trainer = Trainer(None, engine=ex.engine, seed=config.seed)
 
     ckpt_mgr = None
@@ -415,66 +451,68 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                             on_stall=_on_stall)
 
     sink.start()
-    try:
-        with profile(config.profile_dir):
-            fit = trainer.fit(train_ds, epochs=config.epochs,
-                              batch_size=global_batch,
-                              log_every=config.log_every,
-                              checkpoint_manager=ckpt_mgr,
-                              checkpoint_every=config.checkpoint_every,
-                              metrics_logger=metrics_logger,
-                              watchdog=watchdog,
-                              nan_guard=config.nan_guard)
-    finally:
-        if watchdog is not None:
-            watchdog.close()
-    sink.done(fit["elapsed"])
-    ev = trainer.evaluate(test_ds, batch_size=config.eval_batch)
-    sink.results(ev["accuracy"], loss=ev["loss"])
+    try:  # noqa: the sink (and its supervisor socket) must close on ANY exit
+        try:
+            with profile(config.profile_dir):
+                fit = trainer.fit(train_ds, epochs=config.epochs,
+                                  batch_size=global_batch,
+                                  log_every=config.log_every,
+                                  checkpoint_manager=ckpt_mgr,
+                                  checkpoint_every=config.checkpoint_every,
+                                  metrics_logger=metrics_logger,
+                                  watchdog=watchdog,
+                                  nan_guard=config.nan_guard)
+        finally:
+            if watchdog is not None:
+                watchdog.close()
+        sink.done(fit["elapsed"])
+        ev = trainer.evaluate(test_ds, batch_size=config.eval_batch)
+        sink.results(ev["accuracy"], loss=ev["loss"])
 
-    if config.seq_parallel > 1 and config.tensor_parallel > 1:
-        engine_name = f"composite[dp*tp*sp,{config.attention_impl}]"
-    elif config.seq_parallel > 1:
-        engine_name = f"seq_parallel[{config.attention_impl}]"
-    elif config.tensor_parallel > 1:
-        engine_name = "tensor_parallel"
-    elif config.pipeline_parallel > 1:
-        engine_name = "pipeline_parallel"
-    elif config.expert_parallel > 1:
-        engine_name = "expert_parallel"
-    else:
-        engine_name = config.engine
-    total_devices = (n * config.seq_parallel * config.tensor_parallel
-                     * config.pipeline_parallel * config.expert_parallel)
-    model_name = config.model if config.model_fn is None else getattr(
-        config.model_fn, "__name__", "custom_model_fn")
-    summary = {
-        "engine": engine_name,
-        "model": model_name,
-        "dataset": train_ds.name,
-        "synthetic_data": train_ds.synthetic,
-        "n_devices": total_devices,
-        "data_parallel": n,
-        "seq_parallel": config.seq_parallel,
-        "tensor_parallel": config.tensor_parallel,
-        "pipeline_parallel": config.pipeline_parallel,
-        "expert_parallel": config.expert_parallel,
-        "num_experts": (config.num_experts
-                        if config.expert_parallel > 1 else None),
-        "microbatches": (config.microbatches
-                         if config.pipeline_parallel > 1 else None),
-        "global_batch": global_batch,
-        "epochs": config.epochs,
-        "steps": fit["steps"],
-        "elapsed_s": fit["elapsed"],
-        "examples_per_sec": fit["examples_per_sec"],
-        "examples_per_sec_per_device": fit["examples_per_sec"] / total_devices,
-        "test_accuracy": ev["accuracy"],
-        "test_loss": ev["loss"],
-    }
-    sink.emit("summary", **summary)
-    sink.close()
-    return summary
+        if config.seq_parallel > 1 and config.tensor_parallel > 1:
+            engine_name = f"composite[dp*tp*sp,{config.attention_impl}]"
+        elif config.seq_parallel > 1:
+            engine_name = f"seq_parallel[{config.attention_impl}]"
+        elif config.tensor_parallel > 1:
+            engine_name = "tensor_parallel"
+        elif config.pipeline_parallel > 1:
+            engine_name = "pipeline_parallel"
+        elif config.expert_parallel > 1:
+            engine_name = "expert_parallel"
+        else:
+            engine_name = config.engine
+        total_devices = (n * config.seq_parallel * config.tensor_parallel
+                         * config.pipeline_parallel * config.expert_parallel)
+        model_name = config.model if config.model_fn is None else getattr(
+            config.model_fn, "__name__", "custom_model_fn")
+        summary = {
+            "engine": engine_name,
+            "model": model_name,
+            "dataset": train_ds.name,
+            "synthetic_data": train_ds.synthetic,
+            "n_devices": total_devices,
+            "data_parallel": n,
+            "seq_parallel": config.seq_parallel,
+            "tensor_parallel": config.tensor_parallel,
+            "pipeline_parallel": config.pipeline_parallel,
+            "expert_parallel": config.expert_parallel,
+            "num_experts": (config.num_experts
+                            if config.expert_parallel > 1 else None),
+            "microbatches": (config.microbatches
+                             if config.pipeline_parallel > 1 else None),
+            "global_batch": global_batch,
+            "epochs": config.epochs,
+            "steps": fit["steps"],
+            "elapsed_s": fit["elapsed"],
+            "examples_per_sec": fit["examples_per_sec"],
+            "examples_per_sec_per_device": fit["examples_per_sec"] / total_devices,
+            "test_accuracy": ev["accuracy"],
+            "test_loss": ev["loss"],
+        }
+        sink.emit("summary", **summary)
+        return summary
+    finally:
+        sink.close()
 
 
 def steps_to_accuracy(

@@ -34,8 +34,11 @@ class ResultSink:
         self._events: list[dict] = []
         self._sock: socket.socket | None = None
         if supervisor_address:
+            # 'host' or 'host:port' — bare host keeps the reference's port
+            # 4000 default (reference server.py:121)
+            host, _, port = supervisor_address.partition(":")
             self._sock = socket.create_connection(
-                (supervisor_address, supervisor_port), timeout=10)
+                (host, int(port) if port else supervisor_port), timeout=10)
 
     def emit(self, event: str, **fields: Any) -> dict:
         rec = {"event": event, "time": time.time(), **fields}
