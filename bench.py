@@ -1,31 +1,122 @@
 #!/usr/bin/env python
-"""Benchmark: steady-state training throughput of the flagship MNIST CNN.
+"""Benchmarks. Default mode prints ONE JSON line for the driver:
 
-Prints ONE JSON line:
-  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
 
-Protocol (BASELINE.md): examples/sec/chip for the sync engine on all local
-devices; the measurement window excludes compilation (warmup steps first),
-matching the "steady state" row of the reference-derived metrics.  The
-reference publishes no numbers (BASELINE.md §published: none), so
-``vs_baseline`` is computed against ``bench_baseline.json`` — our own first
-recorded measurement — and defaults to 1.0 until that file exists.
+Modes:
+  python bench.py               throughput + MFU of the flagship MNIST CNN
+  python bench.py --stream      input pipeline: fresh host batches per step,
+                                C++ prefetcher vs pure Python vs resident
+  python bench.py --attention   flash (Pallas) vs dense (XLA) attention
+
+Measurement protocol (upgraded round 3 — see BASELINE.md "methodology"):
+
+* The headline number is **device-bound**: training steps are rolled into
+  one jitted ``lax.scan`` so Python dispatch is out of the measured window,
+  and two window lengths (``SCAN_SHORT``/``SCAN_LONG``) are differenced so
+  any fixed per-call overhead cancels — on this environment the device is
+  reached through a tunnel with a ~140 ms round trip that would otherwise
+  dominate.  The differenced window repeats ``REPEATS`` times and the
+  **median** is reported with its min-max spread.  The r01/r02 metric (a
+  single 30-step Python-dispatch loop) swung 0.87→1.68× with zero commits to
+  the measured path — host/tunnel load, not the program, set the number.
+  The dispatch-loop rate is still reported (``dispatch_value``) for
+  continuity.
+* **MFU** uses an analytic FLOPs model of the training step (3× forward for
+  backward, conv+dense matmul FLOPs only — the standard accounting) against
+  the chip's bf16 peak, detected from ``jax.devices()[0].device_kind``.
+  XLA's own cost analysis is reported alongside as a cross-check.
+* The reference publishes no numbers (BASELINE.md §published: none), so
+  ``vs_baseline`` compares against ``bench_baseline.json`` — our own first
+  recorded measurement with the SAME method (scan vs scan, dispatch vs
+  dispatch; never cross-method).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import statistics
 import time
 from pathlib import Path
 
 import numpy as np
 
 WARMUP_STEPS = 5
-MEASURE_STEPS = 30
+DISPATCH_STEPS = 30
+SCAN_SHORT = 100     # differenced windows: per-step = (t_long − t_short) /
+SCAN_LONG = 2100     # (SCAN_LONG − SCAN_SHORT); any fixed per-call overhead
+                     # (e.g. a remote-device tunnel RTT, ~140 ms here) cancels
+REPEATS = 5
 PER_CHIP_BATCH = 512
 
+# Peak bf16 matmul FLOPs/s per chip, by device_kind substring (first match
+# wins; "v5 lite" must precede a bare "v5").  Public figures: v5e 197, v5p
+# 459, v4 275, v3 123, v2 45, v6e/Trillium 918 TFLOP/s.
+_PEAK_BF16 = (
+    ("v6 lite", 918e12), ("v6e", 918e12),
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
 
-def main() -> None:
+
+def peak_flops(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_BF16:
+        if sub in kind:
+            return peak
+    return None
+
+
+def cnn_train_flops_per_example(shape=(28, 28, 1), features=(32, 64),
+                                dense=128, num_classes=10) -> float:
+    """Analytic FLOPs for one training example of models/cnn.py: conv and
+    dense matmul FLOPs (2·MACs) for the forward pass, ×3 for fwd+bwd (the
+    backward pass costs ~2× forward — standard MFU accounting)."""
+    h, w, c = shape
+    fwd = 0.0
+    for feat in features:
+        fwd += 2.0 * h * w * feat * 9 * c  # 3×3 SAME conv
+        c, h, w = feat, h // 2, w // 2     # 2×2 max-pool
+    fwd += 2.0 * (h * w * c) * dense + 2.0 * dense * num_classes
+    return 3.0 * fwd
+
+
+def _median_spread(vals: list[float]) -> tuple[float, float]:
+    """(median, relative spread).  Spread is the interquartile range over the
+    median when n≥5 (robust to the tunnel's occasional outlier window),
+    max-min over median otherwise."""
+    med = statistics.median(vals)
+    if not med:
+        return med, 0.0
+    if len(vals) >= 5:
+        q = statistics.quantiles(vals, n=4)
+        return med, (q[2] - q[0]) / med
+    return med, (max(vals) - min(vals)) / med
+
+
+def _sync(tree) -> None:
+    """Real completion barrier: materialize one leaf's bytes on the host.
+
+    ``jax.block_until_ready`` can return early on the experimental
+    remote-device platform this environment tunnels through (measured: a
+    400-step dispatch chain "blocked" in 37 ms but took 395 ms to actually
+    produce a value).  Fetching bytes cannot lie — the returned leaf of the
+    last step depends on the whole chain."""
+    import jax
+
+    np.asarray(jax.device_get(jax.tree.leaves(tree)[0]))
+
+
+# ---------------------------------------------------------------------------
+# default mode: training throughput + MFU
+# ---------------------------------------------------------------------------
+
+def bench_throughput() -> None:
     import jax
 
     from distributed_tensorflow_tpu.data.loaders import load_dataset
@@ -36,6 +127,7 @@ def main() -> None:
     mesh = meshlib.create_mesh()
     n = mesh.shape[meshlib.DATA_AXIS]
     global_batch = PER_CHIP_BATCH * n
+    device_kind = jax.devices()[0].device_kind
 
     ds = load_dataset("mnist", split="train")
     # measured f32 here: for this small CNN (1 input channel, 28×28) the
@@ -54,30 +146,310 @@ def main() -> None:
 
     for _ in range(WARMUP_STEPS):
         state, m = eng.step(state, xs, ys)
-    jax.block_until_ready(state)
+    _sync(state)
 
-    t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        state, m = eng.step(state, xs, ys)
-    jax.block_until_ready(state)
-    elapsed = time.perf_counter() - t0
+    # device-bound windows: K steps inside one jit — Python never touches
+    # the measured region — at two lengths, differenced to cancel fixed
+    # per-call overhead (see module docstring)
+    def scan_body(st, _):
+        st, _metrics = eng.step(st, xs, ys)
+        return st, None
 
-    eps = MEASURE_STEPS * global_batch / elapsed
-    eps_per_chip = eps / n
+    def make_scan(k):
+        return jax.jit(
+            lambda st: jax.lax.scan(scan_body, st, None, length=k)[0])
+
+    runs = {k: make_scan(k) for k in (SCAN_SHORT, SCAN_LONG)}
+    for run in runs.values():  # compile outside the window
+        state = run(state)
+    _sync(state)
+
+    scan_rates = []
+    for _ in range(REPEATS):
+        t = {}
+        for k, run in runs.items():
+            t0 = time.perf_counter()
+            state = run(state)
+            _sync(state)
+            t[k] = time.perf_counter() - t0
+        per_step = (t[SCAN_LONG] - t[SCAN_SHORT]) / (SCAN_LONG - SCAN_SHORT)
+        scan_rates.append(global_batch / per_step)
+
+    dispatch_rates = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        for _ in range(DISPATCH_STEPS):
+            state, m = eng.step(state, xs, ys)
+        _sync(state)
+        dispatch_rates.append(
+            DISPATCH_STEPS * global_batch / (time.perf_counter() - t0))
+
+    scan_med, scan_spread = _median_spread(scan_rates)
+    disp_med, disp_spread = _median_spread(dispatch_rates)
+    scan_per_chip = scan_med / n
+    disp_per_chip = disp_med / n
+
+    flops_ex = cnn_train_flops_per_example(
+        shape=ds.x.shape[1:], features=model.features, dense=model.dense,
+        num_classes=model.num_classes)
+    peak = peak_flops(device_kind)
+    mfu = (scan_med * flops_ex) / (n * peak) if peak else None
+
+    # XLA's own count for the whole per-device step program (cross-check;
+    # includes elementwise/optimizer FLOPs the analytic model excludes)
+    xla_flops = None
+    try:  # needs the engine's jitted step for lower(); private but guarded
+        ca = eng._step_fn.lower(state, xs, ys).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        xla_flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        pass
 
     baseline_path = Path(__file__).parent / "bench_baseline.json"
     vs = 1.0
     if baseline_path.exists():
-        base = json.loads(baseline_path.read_text()).get("examples_per_sec_per_chip")
-        if base:
-            vs = eps_per_chip / base
+        base = json.loads(baseline_path.read_text())
+        # same-method comparison only: scan vs scan if recorded, else the
+        # legacy dispatch-loop number vs our dispatch-loop median
+        if base.get("scan_examples_per_sec_per_chip"):
+            vs = scan_per_chip / base["scan_examples_per_sec_per_chip"]
+        elif base.get("examples_per_sec_per_chip"):
+            vs = disp_per_chip / base["examples_per_sec_per_chip"]
 
     print(json.dumps({
         "metric": "mnist_cnn_sync_examples_per_sec_per_chip",
-        "value": round(eps_per_chip, 1),
+        "value": round(scan_per_chip, 1),
         "unit": "examples/sec/chip",
         "vs_baseline": round(vs, 3),
+        "method": (f"jit-scan diff {SCAN_LONG}-{SCAN_SHORT}, "
+                   f"median of {REPEATS}"),
+        "spread": round(scan_spread, 4),
+        "dispatch_value": round(disp_per_chip, 1),
+        "dispatch_spread": round(disp_spread, 4),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "flops_per_example_analytic": int(flops_ex),
+        "xla_flops_per_step": xla_flops,
+        "device": device_kind,
+        "n_devices": n,
+        "global_batch": global_batch,
+        "dtype": "float32",
+        "synthetic": bool(ds.synthetic),
     }))
+
+
+# ---------------------------------------------------------------------------
+# --stream: input pipeline (fresh host batches per step)
+# ---------------------------------------------------------------------------
+
+def bench_stream(steps: int = 100) -> None:
+    """Training throughput when every step consumes a FRESH host batch —
+    the configuration the C++ prefetcher (native/src/pipeline.cc) exists
+    for.  'resident' (one device batch reused, the default bench) bounds the
+    attainable rate from above."""
+    import jax
+
+    from distributed_tensorflow_tpu.data.loaders import load_dataset
+    from distributed_tensorflow_tpu.engines import SyncEngine
+    from distributed_tensorflow_tpu.models import create_model
+    from distributed_tensorflow_tpu.native import load as native_load
+    from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+    mesh = meshlib.create_mesh()
+    n = mesh.shape[meshlib.DATA_AXIS]
+    global_batch = PER_CHIP_BATCH * n
+
+    ds = load_dataset("mnist", split="train")
+    model = create_model("cnn", num_classes=ds.num_classes)
+    eng = SyncEngine(model, mesh=mesh)
+    state = eng.init_state(jax.random.key(0), ds.x[:n])
+
+    def run_epoch_stream(native: bool | None, st, max_steps: int):
+        done = 0
+        epoch = 0
+        t0 = time.perf_counter()
+        while done < max_steps:
+            for bx, by, _ in ds.batches(global_batch, shuffle=True, seed=0,
+                                        epoch=epoch, drop_remainder=True,
+                                        native=native):
+                xs, ys = eng.shard_batch(bx, by)
+                st, _m = eng.step(st, xs, ys)
+                done += 1
+                if done >= max_steps:
+                    break
+            epoch += 1
+        _sync(st)
+        return st, done * global_batch / (time.perf_counter() - t0)
+
+    # compile + warm both producer paths (the native pass also constructs
+    # the C++ pool and staging buffers outside the timed window)
+    state, _ = run_epoch_stream(False, state, WARMUP_STEPS)
+    have_native = native_load() is not None
+    if have_native:
+        state, _ = run_epoch_stream(True, state, WARMUP_STEPS)
+
+    rows: dict[str, float] = {}
+    for label, native in [("python", False)] + (
+            [("native", True)] if have_native else []):
+        rates = []
+        for _ in range(3):
+            state, r = run_epoch_stream(native, state, steps)
+            rates.append(r)
+        rows[label], _ = _median_spread(rates)
+
+    # resident upper bound: one device batch, no host input at all
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, len(ds.x), global_batch)
+    xs, ys = eng.shard_batch(ds.x[idx], ds.y[idx])
+    for _ in range(WARMUP_STEPS):
+        state, _m = eng.step(state, xs, ys)
+    _sync(state)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, _m = eng.step(state, xs, ys)
+    _sync(state)
+    rows["resident"] = steps * global_batch / (time.perf_counter() - t0)
+
+    # host-only producer rate: the C++ gather pool vs the numpy gather,
+    # device out of the loop entirely (this is where the prefetcher acts;
+    # the end-to-end rows above also carry host→device transfer)
+    producer: dict[str, float] = {}
+    for label, native in [("python", False)] + (
+            [("native", True)] if have_native else []):
+        for _b in ds.batches(global_batch, shuffle=True, native=native):
+            pass  # warm
+        rates = []
+        for rep in range(3):
+            t0 = time.perf_counter()
+            count = 0
+            for bx, _by, _bm in ds.batches(global_batch, shuffle=True,
+                                           seed=rep, native=native):
+                count += len(bx)
+            rates.append(count / (time.perf_counter() - t0))
+        producer[label], _ = _median_spread(rates)
+
+    print(json.dumps({
+        "metric": "mnist_cnn_stream_examples_per_sec",
+        "unit": "examples/sec",
+        "global_batch": global_batch,
+        "steps": steps,
+        "native_available": have_native,
+        "host_cores": os.cpu_count(),
+        **{f"{k}_examples_per_sec": round(v, 1) for k, v in rows.items()},
+        "native_vs_python": (round(rows["native"] / rows["python"], 3)
+                             if "native" in rows else None),
+        **{f"producer_{k}_rows_per_sec": round(v, 1)
+           for k, v in producer.items()},
+        "producer_native_vs_python": (
+            round(producer["native"] / producer["python"], 3)
+            if "native" in producer else None),
+        "device": jax.devices()[0].device_kind,
+        "synthetic": bool(ds.synthetic),
+    }))
+
+
+# ---------------------------------------------------------------------------
+# --attention: Pallas flash kernel vs XLA dense attention
+# ---------------------------------------------------------------------------
+
+def bench_attention(batch: int = 4, heads: int = 8, head_dim: int = 128,
+                    seq_lens: tuple[int, ...] = (1024, 4096),
+                    causal: bool = True) -> None:
+    """fwd+bwd step time of flash (ops/flash_attention.py) vs dense XLA
+    attention.  This is the measurement behind any speed claim the flash
+    kernel makes (VERDICT r2: 'measure it on the chip or delete the claim')."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.ops.flash_attention import flash_attention
+    from distributed_tensorflow_tpu.parallel.ring_attention import dense_attention
+
+    device_kind = jax.devices()[0].device_kind
+    results = []
+    for L in seq_lens:
+        key = jax.random.key(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (batch, L, heads, head_dim)
+        q = jax.random.normal(kq, shape, jnp.float32)
+        k = jax.random.normal(kk, shape, jnp.float32)
+        v = jax.random.normal(kv, shape, jnp.float32)
+
+        def make_scan(attn, length):
+            """fwd+bwd chained ``length`` times inside one jit: the next q
+            depends on ALL THREE grads (a tiny epsilon keeps dk/dv live —
+            carrying dq alone would let XLA dead-code the dk/dv backward,
+            and asymmetrically so between the two impls), so the calls
+            serialize on the device and nothing is DCE'd; two lengths
+            difference away fixed dispatch overhead."""
+            grad_fn = jax.grad(lambda q_, k_, v_: attn(q_, k_, v_).sum(),
+                               argnums=(0, 1, 2))
+
+            def body(q_c, _):
+                dq, dk, dv = grad_fn(q_c, k, v)
+                return dq + 1e-30 * (dk + dv), None
+
+            return jax.jit(lambda q0: jax.lax.scan(
+                body, q0, None, length=length)[0])
+
+        impls = {
+            "dense": lambda q_, k_, v_: dense_attention(
+                q_, k_, v_, causal=causal),
+            "flash": lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, causal=causal),
+        }
+        row = {"seq_len": L}
+        K_UNIT = 100  # one compiled scan per impl; windows chain m calls
+        for name, attn in impls.items():
+            unit = make_scan(attn, K_UNIT)
+
+            def window(m, unit=unit):
+                """m chained unit-scan calls, timed to real completion."""
+                t0 = time.perf_counter()
+                qq = q
+                for _ in range(m):
+                    qq = unit(qq)
+                _sync(qq)
+                return time.perf_counter() - t0
+
+            _sync(unit(q))  # compile (the only compile for this impl/L)
+            # probe: size the long window to ~2 s of real compute so the
+            # tunnel's multi-hundred-ms per-call jitter averages out;
+            # (t(6)−t(1))/5 cancels the round trip
+            u = max((window(6) - window(1)) / 5, 1e-4)
+            m_long = int(min(max(round(2.0 / u), 2), 60))
+            times = []
+            for _ in range(REPEATS):
+                t_long, t_short = window(m_long), window(1)
+                times.append((t_long - t_short) / ((m_long - 1) * K_UNIT))
+            med, spread = _median_spread(times)
+            row[f"{name}_ms"] = round(med * 1e3, 3)
+            row[f"{name}_spread"] = round(spread, 3)
+            row[f"{name}_window_calls"] = m_long * K_UNIT
+        row["flash_speedup"] = round(row["dense_ms"] / row["flash_ms"], 3)
+        results.append(row)
+
+    print(json.dumps({
+        "metric": "attention_fwd_bwd_step_ms",
+        "config": {"batch": batch, "heads": heads, "head_dim": head_dim,
+                   "causal": causal, "dtype": "float32"},
+        "device": device_kind,
+        "rows": results,
+    }))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--stream", action="store_true",
+                   help="input-pipeline bench (fresh host batches per step)")
+    p.add_argument("--attention", action="store_true",
+                   help="flash vs dense attention on-chip microbench")
+    args = p.parse_args()
+    if args.stream:
+        bench_stream()
+    elif args.attention:
+        bench_attention()
+    else:
+        bench_throughput()
 
 
 if __name__ == "__main__":
