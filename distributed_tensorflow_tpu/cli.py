@@ -14,6 +14,9 @@ N+1 processes):
   -m d  -ds keras   → allreduce engine (RING-allreduce semantics)
   -m d  -ds graph   → gossip engine    (implemented — ref raises
   -m d  -ds custom  → gossip engine     NotImplementedError, init.py:175-181)
+  -m d  -ds fsdp    → fsdp engine      (ZeRO sharded params+optimizer — the
+                                        ref's single-home optimizer,
+                                        server.py:52-55, TPU-first)
   -m t/tpu_pod      → sync engine      (BASELINE.json north-star mode)
 
 ``-n`` selects TPU device count (BASELINE.json: "-n maps to device count");
@@ -53,7 +56,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-cs", "--centralized_strategy", default="sync",
                    choices=["sync", "async"])
     p.add_argument("-ds", "--decentralized_strategy", default="keras",
-                   choices=["keras", "graph", "custom", "sync"])
+                   choices=["keras", "graph", "custom", "sync", "fsdp"])
     p.add_argument("-n", "--number_nodes", type=int, default=None,
                    help="TPU device count (default: all local devices)")
     p.add_argument("-b", "--batch_size", type=int, default=32,
@@ -147,8 +150,8 @@ def select_engine(args: argparse.Namespace) -> str:
     if args.mode in ("d", "decentralized"):
         if args.decentralized_strategy in ("graph", "custom"):
             return "gossip"
-        if args.decentralized_strategy == "sync":
-            return "sync"
+        if args.decentralized_strategy in ("sync", "fsdp"):
+            return args.decentralized_strategy
         return "allreduce"
     return "sync"  # tpu_pod
 

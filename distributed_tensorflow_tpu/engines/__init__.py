@@ -15,6 +15,9 @@ modes (SURVEY.md §7.2(3)):
   gossip     — ring/graph neighbor averaging via `ppermute`, implementing for
                real the reference's NotImplementedError 'graph'/'custom'
                strategies (reference initializer.py:175-181).
+  fsdp       — ZeRO-style fully-sharded data parallelism: params + optimizer
+               state sharded over 'data' (the reference's single-home
+               optimizer, reference server.py:52-55, re-imagined TPU-first).
 """
 
 from distributed_tensorflow_tpu.engines.base import Engine, TrainState  # noqa: F401
@@ -29,12 +32,14 @@ from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine  # noqa: 
 from distributed_tensorflow_tpu.engines.expert_parallel import (  # noqa: F401
     ExpertParallelEngine)
 from distributed_tensorflow_tpu.engines.composite import CompositeEngine  # noqa: F401
+from distributed_tensorflow_tpu.engines.fsdp import FSDPEngine  # noqa: F401
 
 ENGINES = {
     "sync": SyncEngine,
     "async": AsyncLocalEngine,
     "allreduce": SyncEngine,
     "gossip": GossipEngine,
+    "fsdp": FSDPEngine,
 }
 
 

@@ -70,6 +70,7 @@ class Engine:
         self.n_devices = self.mesh.shape[self.axis]
         self._step_fn = None
         self._eval_fn = None
+        self._init_shardings = None  # set by _init_partitioned_state
 
     # ---------------------------------------------------------------- init
     def init_state(self, rng: jax.Array, sample_x: np.ndarray) -> TrainState:
@@ -159,11 +160,18 @@ class Engine:
         return jax.random.fold_in(rng, coll.axis_index(self.axis))
 
     def _init_partitioned_state(self, rng: jax.Array, sample_x,
-                                init_model=None) -> TrainState:
+                                init_model=None,
+                                spec_fn=None) -> TrainState:
         """Sharded init for GSPMD engines: abstract-eval the init to read
         the model's `with_partitioning` annotations, then jit-init with
         those shardings so large params materialize already sharded (never
         replicated-then-resharded).  Unannotated params replicate.
+
+        ``spec_fn`` overrides the annotation-derived specs: it receives the
+        UNBOXED abstract state tree and returns a matching tree of
+        `PartitionSpec`s (the FSDP engine derives specs from leaf shapes
+        this way).  The resolved shardings are kept on
+        ``self._init_shardings`` for engines that pin step outputs.
 
         The returned state is UNBOXED (plain arrays, no `nn.Partitioned`
         wrappers): the annotations' only runtime job is done once the arrays
@@ -192,8 +200,12 @@ class Engine:
             return nn.unbox(boxed_init(rng))
 
         abstract = jax.eval_shape(boxed_init, rng)
-        specs = nn.get_partition_spec(abstract)
+        if spec_fn is None:
+            specs = nn.get_partition_spec(abstract)
+        else:
+            specs = spec_fn(nn.unbox(abstract))
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), specs,
             is_leaf=lambda s: isinstance(s, P))
+        self._init_shardings = shardings
         return jax.jit(init_fn, out_shardings=shardings)(rng)

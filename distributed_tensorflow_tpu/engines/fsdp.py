@@ -1,0 +1,151 @@
+"""FSDP engine: ZeRO-style fully-sharded data parallelism via GSPMD.
+
+The reference's core design insight is that the optimizer lives in exactly
+one place — the server owns the single model and optimizer and workers hold
+only transient replicas (reference server.py:52-55, 148-155; client.py:72).
+The TPU-first rendering of "parameters and optimizer state are not
+replicated" is ZeRO/FSDP: every parameter AND its optimizer moments are
+*sharded over the data axis*, all-gathered just-in-time for each layer's
+compute, with gradients reduce-scattered back to their owning shard.  Per
+device that is ~1/n of the replicated memory — the only DP mode whose model
+size can exceed a single chip's HBM.
+
+Compiler-driven like the TP engine (engines/tensor_parallel.py): we place
+each state leaf with a `NamedSharding` that splits its largest
+n-divisible dimension over ``data``, run the whole step under one
+`jax.jit`, and XLA GSPMD inserts the all-gather-on-use /
+reduce-scatter-on-grad collectives — the scaling-book recipe, no manual
+collectives.  Unlike the TP engine the shardings are derived from leaf
+*shapes*, not model annotations, so ANY registered model works unmodified.
+
+Math is identical to the sync engine (same global-batch-mean loss, same
+optimizer applied to the same gradients — just sharded), verified by the
+parity test in tests/test_fsdp.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.engines.base import (
+    Engine, TrainState, cross_entropy)
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+
+def fsdp_spec(shape: tuple[int, ...], n: int,
+              axis: str = meshlib.DATA_AXIS) -> P:
+    """PartitionSpec sharding the largest ``n``-divisible dim over ``axis``.
+
+    Leaves with no divisible dimension (odd-sized biases, scalars, PRNG
+    keys) replicate — they are a negligible fraction of model bytes."""
+    best = None
+    for i, d in enumerate(shape):
+        if d % n == 0 and d > 0 and (best is None or d > shape[best]):
+            best = i
+    if best is None:
+        return P()
+    spec: list[str | None] = [None] * len(shape)
+    spec[best] = axis
+    return P(*spec)
+
+
+class FSDPEngine(Engine):
+    """Fully-sharded sync data parallelism on a 1-D ('data',) mesh.
+
+    Same step semantics as SyncEngine; different state layout: params and
+    optimizer state are sharded over ``data`` (ZeRO-3), so per-device state
+    bytes shrink ~1/n while the training math stays bit-comparable."""
+
+    def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3):
+        super().__init__(model, optimizer, mesh, learning_rate)
+        self._state_shardings = None
+
+    # ---------------------------------------------------------------- init
+    def init_state(self, rng: jax.Array, sample_x) -> TrainState:
+        """Materialize the state already sharded (never replicated first):
+        the base GSPMD init scaffolding with specs derived from leaf SHAPES
+        instead of model annotations (any model works unmodified)."""
+        n = self.n_devices
+        state = self._init_partitioned_state(
+            rng, sample_x,
+            spec_fn=lambda abstract: jax.tree.map(
+                lambda leaf: fsdp_spec(leaf.shape, n), abstract))
+        self._state_shardings = self._init_shardings
+        return state
+
+    # ---------------------------------------------------------------- step
+    def _build_step(self):
+        apply_fn = self.model.apply
+        tx = self.tx
+
+        def train_step(state: TrainState, x, y):
+            rng = jax.random.fold_in(state.rng, state.step)
+
+            def loss_fn(params):
+                logits = apply_fn({"params": params}, x, train=True,
+                                  rngs={"dropout": rng})
+                loss = cross_entropy(logits, y).mean()
+                acc = (logits.argmax(-1) == y).mean()
+                return loss, acc
+
+            # jit semantics are global: `loss` is the global batch mean.
+            # XLA all-gathers each param for its layer's compute and
+            # reduce-scatters the grad back to the owning shard; the
+            # optimizer update below then runs fully sharded (ZeRO).
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params)
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
+            return state.replace(step=state.step + 1, params=params,
+                                 opt_state=opt_state), \
+                {"loss": loss, "accuracy": acc}
+
+        # pin the output state to the FSDP layout: without the constraint
+        # GSPMD is free to re-layout (e.g. replicate small leaves), which
+        # would silently grow per-device memory step over step
+        compiled = {}
+
+        def step_fn(state, x, y):
+            if "fn" not in compiled:
+                shardings = (self._state_shardings
+                             if self._state_shardings is not None
+                             else jax.tree.map(lambda l: l.sharding, state))
+                metric_sh = NamedSharding(self.mesh, P())
+                compiled["fn"] = jax.jit(
+                    train_step, donate_argnums=0,
+                    out_shardings=(shardings,
+                                   {"loss": metric_sh, "accuracy": metric_sh}))
+            return compiled["fn"](state, x, y)
+
+        return step_fn
+
+    # ---------------------------------------------------------------- eval
+    def _build_eval(self):
+        """GSPMD eval (params stay sharded, gathered per layer) — the base
+        class's shard_map eval would re-replicate the whole param tree."""
+        apply_fn = self.model.apply
+
+        def eval_step(params, x, y, mask):
+            logits = apply_fn({"params": params}, x, train=False)
+            correct = ((logits.argmax(-1) == y) * mask).sum()
+            loss_sum = (cross_entropy(logits, y) * mask).sum()
+            return correct, loss_sum, mask.sum()
+
+        return jax.jit(eval_step)
+
+    # ------------------------------------------------------------- helpers
+    def state_bytes_per_device(self, state: TrainState) -> tuple[int, int]:
+        """(bytes on one device, bytes if fully replicated) for params +
+        optimizer state — the FSDP memory claim, asserted in tests."""
+        dev = self.mesh.devices.flat[0]
+        per_dev = 0
+        total = 0
+        for leaf in jax.tree.leaves((state.params, state.opt_state)):
+            total += leaf.nbytes
+            for shard in leaf.addressable_shards:
+                if shard.device == dev:
+                    per_dev += shard.data.nbytes
+        return per_dev, total

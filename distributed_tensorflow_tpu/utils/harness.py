@@ -33,7 +33,7 @@ class ExperimentConfig:
     """Everything the reference CLI configures (reference initializer.py:72-114),
     plus the TPU-native knobs."""
 
-    engine: str = "sync"            # sync | async | allreduce | gossip
+    engine: str = "sync"            # sync | async | allreduce | gossip | fsdp
     model: str = "mlp"
     dataset: str = "mnist"
     n_devices: int | None = None    # the reference's -n, as TPU device count
