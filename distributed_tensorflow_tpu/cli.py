@@ -86,8 +86,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-sp", "--seq-parallel", type=int, default=1,
                    help="shard sequences over this many devices (long-context "
                         "mode; requires a sequence model, e.g. --model bert_tiny)")
-    p.add_argument("--attention", default="ring", choices=["ring", "ulysses"],
-                   help="sequence-parallel attention strategy")
+    p.add_argument("--attention", default="ring",
+                   choices=["ring", "ring_flash", "ulysses", "flash"],
+                   help="attention strategy: ring/ring_flash/ulysses shard "
+                        "the sequence over -sp devices (ring_flash = ring "
+                        "schedule with the Pallas flash kernel as local "
+                        "math); flash = single-device Pallas kernel, valid "
+                        "only with -sp 1 (sequence models)")
     p.add_argument("-tp", "--tensor-parallel", type=int, default=1,
                    help="shard weight matrices over this many devices "
                         "(Megatron-style TP; MLP family)")
@@ -96,6 +101,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(GPipe-style microbatched pipeline)")
     p.add_argument("--microbatches", type=int, default=4,
                    help="pipeline microbatches per step (bubble = (S-1)/(M+S-1))")
+    p.add_argument("--pipeline-schedule", default="gpipe",
+                   choices=["gpipe", "1f1b"],
+                   help="gpipe: all-fwd-then-all-bwd (AD through the scan); "
+                        "1f1b: interleaved fwd/bwd with a fixed S-slot "
+                        "activation stash (PipeDream-flush)")
     p.add_argument("--pipeline-hidden", type=int, default=128,
                    help="pipeline stage hidden width")
     p.add_argument("-ep", "--expert-parallel", type=int, default=1,
@@ -206,6 +216,7 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         tensor_parallel=args.tensor_parallel,
         pipeline_parallel=args.pipeline_parallel,
         microbatches=args.microbatches,
+        pipeline_schedule=args.pipeline_schedule,
         pipeline_hidden=args.pipeline_hidden,
         expert_parallel=args.expert_parallel,
         num_experts=args.num_experts,

@@ -113,6 +113,20 @@ class Engine:
         per-device parameter copies override to average first."""
         return state.params
 
+    def _build_eval_gspmd(self, logits_fn):
+        """Masked eval under plain jit (GSPMD semantics: params keep their
+        shardings, XLA gathers per layer).  Shared by the engines whose
+        params must not be re-replicated wholesale (fsdp, pipeline); the
+        base shard_map eval below is for replicated-param engines."""
+
+        def eval_step(params, x, y, mask):
+            logits = logits_fn(params, x)
+            correct = ((logits.argmax(-1) == y) * mask).sum()
+            loss_sum = (cross_entropy(logits, y) * mask).sum()
+            return correct, loss_sum, mask.sum()
+
+        return jax.jit(eval_step)
+
     def _build_eval(self):
         apply_fn = self.model.apply
         axis = self.axis

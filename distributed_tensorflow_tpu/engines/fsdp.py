@@ -127,20 +127,16 @@ class FSDPEngine(Engine):
         """GSPMD eval (params stay sharded, gathered per layer) — the base
         class's shard_map eval would re-replicate the whole param tree."""
         apply_fn = self.model.apply
-
-        def eval_step(params, x, y, mask):
-            logits = apply_fn({"params": params}, x, train=False)
-            correct = ((logits.argmax(-1) == y) * mask).sum()
-            loss_sum = (cross_entropy(logits, y) * mask).sum()
-            return correct, loss_sum, mask.sum()
-
-        return jax.jit(eval_step)
+        return self._build_eval_gspmd(
+            lambda params, x: apply_fn({"params": params}, x, train=False))
 
     # ------------------------------------------------------------- helpers
     def state_bytes_per_device(self, state: TrainState) -> tuple[int, int]:
-        """(bytes on one device, bytes if fully replicated) for params +
-        optimizer state — the FSDP memory claim, asserted in tests."""
-        dev = self.mesh.devices.flat[0]
+        """(bytes on one local device, bytes if fully replicated) for params
+        + optimizer state — the FSDP memory claim, asserted in tests.  Uses
+        the first *addressable* device so the count is real on every host
+        of a multi-process mesh (mesh.devices.flat[0] belongs to host 0)."""
+        dev = jax.local_devices()[0]
         per_dev = 0
         total = 0
         for leaf in jax.tree.leaves((state.params, state.opt_state)):

@@ -82,6 +82,20 @@ class PipelineHead(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def _match_vma(a, b):
+    """Cast ``a`` to ``b``'s dtype and widen its varying-axes set to match.
+
+    Used where an embed output joins the rotating buffer: leaves touching
+    the pre-cast varying params join for free; leaves derived from the
+    device-invariant token input alone (e.g. a carried padding mask) are
+    upcast here.  Differentiated paths never traverse those leaves, so this
+    in-branch pcast transposes to nothing (no collective inside a cond)."""
+    missing = tuple(set(jax.typeof(b).vma) - set(jax.typeof(a).vma))
+    if missing:
+        a = lax.pcast(a, missing, to="varying")
+    return a.astype(b.dtype)
+
+
 def _pipe_spec_tree(tree):
     """PartitionSpec tree: leaves under a 'blocks' dict key are stage-stacked
     → sharded P('pipe') on the leading (stage) dim; everything else
@@ -104,14 +118,31 @@ class PipelineEngine(Engine):
     pipe-axis size.  ``microbatches`` (M) must divide the per-data-shard
     batch.  Throughput approaches M/(M+S-1) of bubble-free as M grows.
 
+    ``schedule`` picks the microbatch schedule:
+
+    * ``'gpipe'`` (default): all-forward-then-all-backward via `jax.grad`
+      through the tick scan.  AD stores one residual set per tick, so
+      activation memory grows with M + S − 1.
+    * ``'1f1b'``: the one-forward-one-backward schedule (PipeDream-flush):
+      after an S-tick warmup each device alternates forward and backward
+      microbatches, so at most S microbatches are ever in flight and the
+      activation stash is a fixed S slots regardless of M.  Backward is
+      hand-scheduled with per-stage `jax.vjp` (input-stash + recompute),
+      cotangents ride a reverse `ppermute` ring; the math is identical to
+      GPipe (same grads, different order — tests/test_pipeline.py holds
+      both to the same sequential oracle).
+
     ``stages`` plugs in custom (embed, block, head) modules — e.g.
     ``models.bert.bert_pipeline_stages`` to pipeline a transformer encoder.
     Contract: ``block(carry) -> carry`` where ``carry`` is whatever pytree
     ``embed(x)`` returns (it rides the pipe-axis ppermute between stages, so
     keep it activation-sized), every stage has identical parameter structure
     (they are stacked and sharded P('pipe')), and all three modules are
-    deterministic — the schedule re-applies embed/head every tick, so rng-
-    consuming ops (dropout) would draw inconsistent masks across ticks.
+    deterministic — the schedule replays the tick program under AD, so rng-
+    consuming ops (dropout) would need tick-stable keys the stage contract
+    does not provide.  Embed runs only on stage 0 during the fill and head
+    only on the last stage during the drain (`lax.cond`, so the other
+    stages genuinely skip those FLOPs rather than mask them).
     """
 
     def __init__(
@@ -125,10 +156,15 @@ class PipelineEngine(Engine):
         expansion: int = 2,
         dtype: jnp.dtype = jnp.float32,
         stages: tuple[nn.Module, nn.Module, nn.Module] | None = None,
+        schedule: str = "gpipe",
     ):
         if mesh is None or set(mesh.axis_names) != {meshlib.DATA_AXIS,
                                                     meshlib.PIPE_AXIS}:
             raise ValueError("PipelineEngine requires a ('data','pipe') mesh")
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown schedule '{schedule}'; "
+                             f"choose 'gpipe' or '1f1b'")
+        self.schedule = schedule
         if stages is not None:
             self.embed, self.block, self.head = stages
         else:
@@ -174,6 +210,11 @@ class PipelineEngine(Engine):
 
     # ---------------------------------------------------------------- step
     def _build_step(self):
+        if self.schedule == "1f1b":
+            return self._build_step_1f1b()
+        return self._build_step_gpipe()
+
+    def _build_step_gpipe(self):
         tx = self.tx
         embed, block, head = self.embed, self.block, self.head
         M = self.microbatches
@@ -190,28 +231,67 @@ class PipelineEngine(Engine):
 
             def loss_fn(params):
                 blocks_local = jax.tree.map(lambda a: a[0], params["blocks"])
+                # embed/head params enter replicated (pipe-invariant); cast
+                # them varying HERE, outside the conds below.  The AD
+                # transpose of this pcast is the psum that combines their
+                # grads across the mesh — it must not live inside a cond
+                # branch that only some devices execute (a collective in a
+                # partially-taken ConditionalThunk deadlocks/aborts), and
+                # hoisting it here also means one psum per step instead of
+                # one per tick.
+                both = (data_axis, pipe_axis)
+                embed_v = jax.tree.map(
+                    lambda a: lax.pcast(a, both, to="varying"),
+                    params["embed"])
+                head_v = jax.tree.map(
+                    lambda a: lax.pcast(a, both, to="varying"),
+                    params["head"])
 
                 def tick(buf, i):
-                    # stage 0 injects microbatch i (clamped past the drain)
+                    # stage 0 injects microbatch i — under lax.cond, so only
+                    # stage 0 (and only during the fill, i < M) pays the
+                    # embed FLOPs: the predicate is device-varying, and in
+                    # shard_map's per-device SPMD program each core takes
+                    # its own branch at runtime.  Other stages (and the
+                    # drain ticks) pass their rotated buffer through; that
+                    # garbage follows a path that never reaches the head
+                    # within this scan (a microbatch injected at tick
+                    # i ≥ M would drain at i+S-1 > the last tick M+S-2)
                     xi = lax.dynamic_index_in_dim(
                         micro_x, jnp.clip(i, 0, M - 1), keepdims=False)
-                    h_src = embed.apply({"params": params["embed"]}, xi)
-                    h_src = jax.tree.map(
-                        lambda a: lax.pcast(a, pipe_axis, to="varying"), h_src)
-                    h_in = jax.tree.map(
-                        lambda s, b: jnp.where(stage == 0, s, b), h_src, buf)
+
+                    def inject(_):
+                        h = embed.apply({"params": embed_v}, xi)
+                        return jax.tree.map(_match_vma, h, buf)
+
+                    h_in = lax.cond((stage == 0) & (i < M), inject,
+                                    lambda _: buf, None)
                     h_out = block.apply({"params": blocks_local}, h_in)
-                    # last stage drains microbatch i-(S-1)
+                    # last stage drains microbatch i-(S-1); the head matmul
+                    # and loss run only there (again lax.cond, not masking)
                     oi = i - (S - 1)
                     yi = lax.dynamic_index_in_dim(
                         micro_y, jnp.clip(oi, 0, M - 1), keepdims=False)
                     yi = lax.pcast(yi, pipe_axis, to="varying")
-                    logits = head.apply({"params": params["head"]}, h_out)
-                    w = ((oi >= 0) & (oi < M) & (stage == S - 1)).astype(
-                        jnp.float32)
-                    loss_i = cross_entropy(logits, yi).mean() * w
-                    acc_i = (logits.argmax(-1) == yi).mean(
-                        ).astype(jnp.float32) * w
+                    valid = ((oi >= 0) & (oi < M)).astype(jnp.float32)
+                    valid = lax.pcast(valid, pipe_axis, to="varying")
+
+                    def drain(h):
+                        logits = head.apply({"params": head_v}, h)
+                        loss_i = cross_entropy(logits, yi).mean() * valid
+                        acc_i = (logits.argmax(-1) == yi).mean(
+                            ).astype(jnp.float32) * valid
+                        return loss_i, valid, acc_i
+
+                    # branch outputs must carry identical varying-axes
+                    # types: loss/acc are (data, pipe)-varying, w pipe-only
+                    zero_dp = lax.pcast(jnp.zeros((), jnp.float32),
+                                        (data_axis, pipe_axis), to="varying")
+                    zero_p = lax.pcast(jnp.zeros((), jnp.float32),
+                                       pipe_axis, to="varying")
+                    loss_i, w, acc_i = lax.cond(
+                        stage == S - 1, drain,
+                        lambda h: (zero_dp, zero_p, zero_dp), h_out)
                     buf_next = jax.tree.map(
                         lambda a: lax.ppermute(a, axis_name=pipe_axis,
                                                perm=perm), h_out)
@@ -253,8 +333,200 @@ class PipelineEngine(Engine):
                                       opt_state=opt_state)
             return new_state, metrics
 
-        # the in/out spec trees depend on the concrete state structure, so
-        # the shard_map is built lazily on first call
+        return self._wrap_pipe_step(device_step)
+
+    def _build_step_1f1b(self):
+        """One-forward-one-backward schedule, hand-scheduled backward.
+
+        Lockstep timetable (tick t, stage s, microbatch i):
+          fwd(s, i) at t = 2i + s          bwd(s, i) at t = 2i + 2S − 1 − s
+        so fwd and bwd ticks interleave per device (opposite parities), at
+        most S microbatches are in flight per stage (stash is S slots,
+        indexed i mod S — collision-free because in-flight span < S), and
+        the whole step is T = 2(M + S − 1) ticks.  Backward recomputes the
+        stage forward from the stashed INPUT (remat) inside `jax.vjp`;
+        cotangents hop s → s−1 on a reverse ppermute ring.
+
+        Every pcast is hoisted out of the `lax.cond`s: a cond branch taken
+        by only some devices must stay collective-free (see the gpipe tick
+        comment), so all branch operands are pre-cast (data, pipe)-varying
+        and the cross-device grad reductions happen as explicit psums after
+        the scan."""
+        tx = self.tx
+        embed, block, head = self.embed, self.block, self.head
+        M = self.microbatches
+        S = self.n_stages
+        data_axis, pipe_axis = meshlib.DATA_AXIS, meshlib.PIPE_AXIS
+
+        def device_step(state: TrainState, x, y):
+            n_data = lax.axis_size(data_axis)
+            stage = lax.axis_index(pipe_axis)
+            mb = x.shape[0] // M
+            micro_x = lax.pcast(
+                x.reshape((M, mb) + x.shape[1:]), pipe_axis, to="varying")
+            micro_y = lax.pcast(
+                y.reshape((M, mb)), pipe_axis, to="varying")
+            perm_f = [(i, (i + 1) % S) for i in range(S)]
+            perm_b = [(i, (i - 1) % S) for i in range(S)]
+            both = (data_axis, pipe_axis)
+            params = state.params
+
+            # everything a cond branch touches is pre-cast fully varying
+            blocks_v = jax.tree.map(
+                lambda a: lax.pcast(a[0], data_axis, to="varying"),
+                params["blocks"])
+            embed_v = jax.tree.map(
+                lambda a: lax.pcast(a, both, to="varying"), params["embed"])
+            head_v = jax.tree.map(
+                lambda a: lax.pcast(a, both, to="varying"), params["head"])
+            one_v = lax.pcast(jnp.ones((), jnp.float32), both, to="varying")
+            zero_v = one_v * 0.0
+
+            h0 = jax.eval_shape(
+                lambda p, a: embed.apply({"params": p}, a),
+                params["embed"], micro_x[0])
+
+            def zeros_v(tree, lead=()):
+                return jax.tree.map(
+                    lambda a: lax.pcast(jnp.zeros(lead + a.shape, a.dtype),
+                                        both, to="varying"), tree)
+
+            fbuf0, bbuf0 = zeros_v(h0), zeros_v(h0)
+            stash0 = zeros_v(h0, lead=(S,))
+            gblk0 = jax.tree.map(lambda a: a * 0.0, blocks_v)
+            gemb0 = jax.tree.map(lambda a: a * 0.0, embed_v)
+            ghead0 = jax.tree.map(lambda a: a * 0.0, head_v)
+
+            def tick(carry, t):
+                (fbuf, bbuf, stash, g_blk, g_emb, g_head,
+                 loss_s, acc_s, w_s) = carry
+
+                # ---------------- forward sub-tick: fwd(s, i) at t = 2i+s
+                tf = t - stage
+                f_valid = (tf >= 0) & (tf % 2 == 0) & (tf < 2 * M)
+                i_f = jnp.clip(tf // 2, 0, M - 1)
+                xi = lax.dynamic_index_in_dim(micro_x, i_f, keepdims=False)
+
+                def inject(_):
+                    h = embed.apply({"params": embed_v}, xi)
+                    return jax.tree.map(_match_vma, h, fbuf)
+
+                h_in = lax.cond(f_valid & (stage == 0), inject,
+                                lambda _: fbuf, None)
+
+                def fwd(ops):
+                    h_in, stash = ops
+                    h_out = block.apply({"params": blocks_v}, h_in)
+                    stash = jax.tree.map(
+                        lambda st, v: lax.dynamic_update_index_in_dim(
+                            st, v, i_f % S, 0), stash, h_in)
+                    return h_out, stash
+
+                h_out, stash = lax.cond(f_valid, fwd,
+                                        lambda ops: ops, (h_in, stash))
+
+                # --------------- backward sub-tick: bwd(s, i) at 2i+2S-1-s
+                tb = t - (2 * S - 1 - stage)
+                b_valid = (tb >= 0) & (tb % 2 == 0) & (tb < 2 * M)
+                i_b = jnp.clip(tb // 2, 0, M - 1)
+                xb = lax.dynamic_index_in_dim(micro_x, i_b, keepdims=False)
+                yb = lax.dynamic_index_in_dim(micro_y, i_b, keepdims=False)
+
+                def bwd(ops):
+                    bbuf, g_blk, g_emb, g_head, loss_s, acc_s, w_s = ops
+                    h_saved = jax.tree.map(
+                        lambda st: lax.dynamic_index_in_dim(
+                            st, i_b % S, keepdims=False), stash)
+                    # recompute this stage's forward under vjp (remat)
+                    h_re, blk_vjp = jax.vjp(
+                        lambda bp, h: block.apply({"params": bp}, h),
+                        blocks_v, h_saved)
+
+                    def head_cot(_):
+                        def scalar(hv, h):
+                            logits = head.apply({"params": hv}, h)
+                            l_raw = cross_entropy(logits, yb).mean()
+                            acc = (logits.argmax(-1) == yb).mean(
+                                ).astype(jnp.float32)
+                            # same scale as the gpipe path: the psum'd sum
+                            # over stages/shards is the global batch mean
+                            return l_raw / (M * n_data), (l_raw, acc)
+
+                        (g_hv, cot), (l_raw, acc) = jax.grad(
+                            scalar, argnums=(0, 1), has_aux=True)(
+                                head_v, h_re)
+                        return cot, g_hv, l_raw * one_v, acc * one_v, one_v
+
+                    cot_out, g_hv, l_raw, acc, w = lax.cond(
+                        stage == S - 1, head_cot,
+                        lambda _: (bbuf, ghead0, zero_v, zero_v, zero_v),
+                        None)
+                    g_bp, cot_in = blk_vjp(cot_out)
+
+                    def embed_grads(_):
+                        _, evjp = jax.vjp(
+                            lambda p: embed.apply({"params": p}, xb),
+                            embed_v)
+                        return evjp(cot_in)[0]
+
+                    g_e = lax.cond((stage == 0), embed_grads,
+                                   lambda _: gemb0, None)
+                    return (cot_in,
+                            jax.tree.map(jnp.add, g_blk, g_bp),
+                            jax.tree.map(jnp.add, g_emb, g_e),
+                            jax.tree.map(jnp.add, g_head, g_hv),
+                            loss_s + l_raw, acc_s + acc, w_s + w)
+
+                (cot_send, g_blk, g_emb, g_head,
+                 loss_s, acc_s, w_s) = lax.cond(
+                    b_valid, bwd,
+                    lambda ops: ops,
+                    (bbuf, g_blk, g_emb, g_head, loss_s, acc_s, w_s))
+
+                # ring hops happen unconditionally — every device must join
+                fbuf = jax.tree.map(
+                    lambda a: lax.ppermute(a, axis_name=pipe_axis,
+                                           perm=perm_f), h_out)
+                bbuf = jax.tree.map(
+                    lambda a: lax.ppermute(a, axis_name=pipe_axis,
+                                           perm=perm_b), cot_send)
+                return (fbuf, bbuf, stash, g_blk, g_emb, g_head,
+                        loss_s, acc_s, w_s), None
+
+            carry0 = (fbuf0, bbuf0, stash0, gblk0, gemb0, ghead0,
+                      zero_v, zero_v, zero_v)
+            (_, _, _, g_blk, g_emb, g_head,
+             loss_s, acc_s, w_s), _ = lax.scan(
+                tick, carry0, jnp.arange(2 * (M + S - 1)))
+
+            grads = {
+                "embed": jax.tree.map(
+                    lambda a: lax.psum(a, both), g_emb),
+                "blocks": jax.tree.map(
+                    lambda a: lax.psum(a, data_axis)[None], g_blk),
+                "head": jax.tree.map(
+                    lambda a: lax.psum(a, both), g_head),
+            }
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
+            tot_w = lax.psum(w_s, both)
+            metrics = {
+                "loss": lax.psum(loss_s, both) / tot_w,
+                "accuracy": lax.psum(acc_s, both) / tot_w,
+            }
+            new_state = state.replace(step=state.step + 1, params=params,
+                                      opt_state=opt_state)
+            return new_state, metrics
+
+        return self._wrap_pipe_step(device_step)
+
+    def _wrap_pipe_step(self, device_step):
+        """Lazy shard_map+jit wrapper shared by both schedules: the in/out
+        spec trees depend on the concrete state structure, so the shard_map
+        is built on first call.  The jit is kept on ``self._jit_step`` so
+        tests can inspect the compiled HLO (e.g. assert embed/head sit
+        behind `conditional`s)."""
         compiled = {}
 
         def step_fn(state, x, y):
@@ -262,10 +534,12 @@ class PipelineEngine(Engine):
                 spec = _pipe_spec_tree(state)
                 smapped = jax.shard_map(
                     device_step, mesh=self.mesh,
-                    in_specs=(spec, P(data_axis), P(data_axis)),
+                    in_specs=(spec, P(meshlib.DATA_AXIS),
+                              P(meshlib.DATA_AXIS)),
                     out_specs=(spec, P()),
                 )
-                compiled["fn"] = jax.jit(smapped, donate_argnums=0)
+                compiled["fn"] = self._jit_step = jax.jit(
+                    smapped, donate_argnums=0)
             return compiled["fn"](state, x, y)
 
         return step_fn
@@ -275,12 +549,6 @@ class PipelineEngine(Engine):
         return state.params
 
     def _build_eval(self):
-        def eval_step(params, x, y, mask):
-            logits = self._sequential_logits(params, x)
-            correct = ((logits.argmax(-1) == y) * mask).sum()
-            loss_sum = (cross_entropy(logits, y) * mask).sum()
-            return correct, loss_sum, mask.sum()
-
         # GSPMD jit: blocks stay sharded over 'pipe'; XLA moves stage params
         # to where the scan needs them
-        return jax.jit(eval_step)
+        return self._build_eval_gspmd(self._sequential_logits)
