@@ -42,7 +42,7 @@ class CompositeEngine(Engine):
     """Sync training over a ('data', 'model', 'seq') mesh.
 
     Any axis may have size 1; ``seq`` > 1 requires a model whose
-    ``attention_impl`` is 'ring' or 'ulysses' (dense attention on
+    ``attention_impl`` is 'ring', 'ring_flash' or 'ulysses' (dense attention on
     seq-sharded activations would attend within local blocks only).
     """
 
@@ -61,11 +61,11 @@ class CompositeEngine(Engine):
         self.seq_n = mesh.shape.get(meshlib.SEQ_AXIS, 1)
         self.tp_n = mesh.shape.get(meshlib.MODEL_AXIS, 1)
         impl = getattr(model, "attention_impl", "dense")
-        if self.seq_n > 1 and impl not in ("ring", "ulysses"):
+        if self.seq_n > 1 and impl not in ("ring", "ring_flash", "ulysses"):
             raise ValueError(
-                f"seq axis size {self.seq_n} needs attention_impl 'ring' or "
-                f"'ulysses', got '{impl}'")
-        if self.seq_n == 1 and impl in ("ring", "ulysses"):
+                f"seq axis size {self.seq_n} needs attention_impl 'ring', "
+                f"'ring_flash' or 'ulysses', got '{impl}'")
+        if self.seq_n == 1 and impl in ("ring", "ring_flash", "ulysses"):
             # degenerate seq axis: the manual collectives would reference an
             # unbound axis in the plain-jit path — swap in the dense twin
             # (identical params/math on an unsharded sequence)
@@ -78,7 +78,8 @@ class CompositeEngine(Engine):
         trace outside shard_map; param structure is identical) with GSPMD
         shardings read from the model's partitioning annotations."""
         twin = self.model
-        if getattr(twin, "attention_impl", "dense") in ("ring", "ulysses"):
+        if getattr(twin, "attention_impl", "dense") in ("ring", "ring_flash",
+                                                       "ulysses"):
             twin = twin.clone(attention_impl="dense")
         return self._init_partitioned_state(rng, sample_x, init_model=twin)
 

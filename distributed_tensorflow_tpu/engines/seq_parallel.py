@@ -36,7 +36,7 @@ class SeqParallelEngine(Engine):
     """Data×sequence parallel sync training.
 
     ``mesh`` must have axes ('data', 'seq'); the model's ``attention_impl``
-    must be 'ring' or 'ulysses' with ``seq_axis='seq'``.
+    must be 'ring', 'ring_flash' or 'ulysses' with ``seq_axis='seq'``.
     """
 
     seq_axis = meshlib.SEQ_AXIS
@@ -47,10 +47,11 @@ class SeqParallelEngine(Engine):
                              "('data','seq') mesh")
         if set(mesh.axis_names) != {meshlib.DATA_AXIS, meshlib.SEQ_AXIS}:
             raise ValueError(f"mesh axes must be (data, seq), got {mesh.axis_names}")
-        if getattr(model, "attention_impl", None) not in ("ring", "ulysses"):
+        if getattr(model, "attention_impl", None) not in (
+                "ring", "ring_flash", "ulysses"):
             raise ValueError(
-                "SeqParallelEngine needs a model with attention_impl 'ring' or "
-                "'ulysses' — dense attention on sequence-sharded activations "
+                "SeqParallelEngine needs a model with attention_impl 'ring', "
+                "'ring_flash' or 'ulysses' — dense attention on sequence-sharded activations "
                 "would silently attend within local blocks only")
         super().__init__(model, optimizer, mesh, learning_rate)
         self.seq_n = mesh.shape[self.seq_axis]

@@ -6,15 +6,19 @@ BASELINE.json adds "BERT-tiny GLUE fine-tune" as a stretch benchmark.
 Standard BERT-tiny shape: 2 layers, hidden 128, 2 heads, FFN 512.
 
 Attention is pluggable (``attention_impl``):
-  'dense'   — ordinary full attention; any mesh, no seq sharding.
-  'flash'   — Pallas flash-attention kernel (ops.flash_attention): exact
-              same math as 'dense' but blockwise in VMEM — O(L) memory,
-              the TPU-native choice for long single-device sequences.
-  'ring'    — ring attention over the ``seq`` mesh axis; the model must run
-              inside `jax.shard_map` with the token dim sharded over 'seq'
-              (see engines.seq_parallel).  K/V blocks rotate via ppermute.
-  'ulysses' — all-to-all head-parallel attention over 'seq'; same contract,
-              plus num_heads % seq_axis_size == 0.
+  'dense'      — ordinary full attention; any mesh, no seq sharding.
+  'flash'      — Pallas flash-attention kernel (ops.flash_attention): exact
+                 same math as 'dense' but blockwise in VMEM — O(L) memory,
+                 the TPU-native choice for long single-device sequences.
+  'ring'       — ring attention over the ``seq`` mesh axis; the model must
+                 run inside `jax.shard_map` with the token dim sharded over
+                 'seq' (see engines.seq_parallel).  K/V rotate via ppermute.
+  'ring_flash' — ring schedule with the Pallas flash kernel as the local
+                 block math (parallel.ring_attention.ring_flash_attention):
+                 long-context memory scaling AND the kernel's on-chip wins
+                 (BASELINE.md §attention).  Same contract as 'ring'.
+  'ulysses'    — all-to-all head-parallel attention over 'seq'; same
+                 contract, plus num_heads % seq_axis_size == 0.
 
 Input is int32 token ids (B, L_local); 0 is the padding id and is masked out
 of attention.  The classification head reads the [CLS] position (global
@@ -38,7 +42,7 @@ import jax.numpy as jnp
 from distributed_tensorflow_tpu.parallel import collectives as coll
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 from distributed_tensorflow_tpu.parallel.ring_attention import (
-    dense_attention, ring_attention, ulysses_attention)
+    dense_attention, ring_attention, ring_flash_attention, ulysses_attention)
 
 
 def _part(init, spec, enabled: bool):
@@ -82,6 +86,9 @@ class SelfAttention(nn.Module):
         q, k, v = proj("query"), proj("key"), proj("value")
         if self.attention_impl == "ring":
             out = ring_attention(q, k, v, axis=self.seq_axis, kv_mask=pad_mask)
+        elif self.attention_impl == "ring_flash":
+            out = ring_flash_attention(q, k, v, axis=self.seq_axis,
+                                       kv_mask=pad_mask)
         elif self.attention_impl == "ulysses":
             out = ulysses_attention(q, k, v, axis=self.seq_axis, kv_mask=pad_mask)
         elif self.attention_impl == "flash":
@@ -191,7 +198,8 @@ class BertTinyClassifier(nn.Module):
 
     @nn.compact
     def __call__(self, token_ids, train: bool = False):
-        seq_parallel = self.attention_impl in ("ring", "ulysses")
+        seq_parallel = self.attention_impl in ("ring", "ring_flash",
+                                               "ulysses")
         pad_mask = (token_ids > 0).astype(self.dtype)
         lq = token_ids.shape[1]
         # nn.Embed clamps out-of-range gathers silently — fail loudly instead

@@ -52,6 +52,16 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _join_vma(*xs) -> frozenset:
+    """Union of the operands' varying-axes sets — pallas_call outputs must
+    declare their vma explicitly when running inside `jax.shard_map`
+    (check_vma); outside shard_map this is the empty set."""
+    vma = frozenset()
+    for x in xs:
+        vma |= jax.typeof(x).vma
+    return vma
+
+
 def _block_spec(shape, index_map):
     if _VMEM is None:
         return pl.BlockSpec(shape, index_map)
@@ -145,8 +155,10 @@ def _fwd(q, k, v, mask, scale, causal, bq, bk, interpret):
             _block_spec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, lq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, d), q.dtype,
+                                 vma=_join_vma(q, k, v, mask)),
+            jax.ShapeDtypeStruct((bh, 1, lq), jnp.float32,
+                                 vma=_join_vma(q, k, v, mask)),
         ],
         scratch_shapes=[
             _VMEM((bq, 1), jnp.float32) if _VMEM else None,
@@ -228,12 +240,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd(q, k, v, mask, out, lse, do, scale, causal, bq, bk, interpret):
+def _bwd(q, k, v, mask, lse, delta, do, scale, causal, bq, bk, interpret):
+    """delta = Σ_d do·out over the FULL attention output — callers computing
+    blockwise/ring gradients pass the global delta (the flash backward math
+    needs global lse + delta even for one k-block's contribution)."""
     bh, lq, d = q.shape
     lk = k.shape[1]
     nq, nk = lq // bq, lk // bk
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True).transpose(0, 2, 1)     # (BH, 1, Lq)
 
     qspec = _block_spec((1, bq, d), lambda b, x, y: (b, x, 0))
     kspec_q_outer = _block_spec((1, bk, d), lambda b, i, j: (b, j, 0))
@@ -247,7 +260,8 @@ def _bwd(q, k, v, mask, out, lse, do, scale, causal, bq, bk, interpret):
                   _block_spec((1, 1, bk), lambda b, i, j: (b, 0, j)),
                   qspec, rowspec, rowspec],
         out_specs=[qspec],
-        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+        out_shape=[jax.ShapeDtypeStruct(
+            q.shape, q.dtype, vma=_join_vma(q, k, v, mask, do, lse, delta))],
         scratch_shapes=[_VMEM((bq, d), jnp.float32) if _VMEM else None],
         interpret=interpret,
     )(q, k, v, mask, do, lse, delta)[0]
@@ -264,8 +278,12 @@ def _bwd(q, k, v, mask, out, lse, do, scale, causal, bq, bk, interpret):
                   _block_spec((1, 1, bk), lambda b, j, i: (b, 0, j)),
                   qspec_k_outer, rowspec_k_outer, rowspec_k_outer],
         out_specs=[kspec, kspec],
-        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
-                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct(
+                       k.shape, k.dtype,
+                       vma=_join_vma(q, k, v, mask, do, lse, delta)),
+                   jax.ShapeDtypeStruct(
+                       v.shape, v.dtype,
+                       vma=_join_vma(q, k, v, mask, do, lse, delta))],
         scratch_shapes=[_VMEM((bk, d), jnp.float32) if _VMEM else None,
                         _VMEM((bk, d), jnp.float32) if _VMEM else None],
         interpret=interpret,
@@ -290,7 +308,9 @@ def _flash_core_fwd(q, k, v, mask, scale, causal, bq, bk, interpret):
 
 def _flash_core_bwd(scale, causal, bq, bk, interpret, res, do):
     q, k, v, mask, out, lse = res
-    dq, dk, dv = _bwd(q, k, v, mask, out, lse, do,
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True).transpose(0, 2, 1)     # (BH, 1, Lq)
+    dq, dk, dv = _bwd(q, k, v, mask, lse, delta, do,
                       scale, causal, bq, bk, interpret)
     return dq, dk, dv, jnp.zeros_like(mask)
 
@@ -335,6 +355,16 @@ def flash_attention(q, k, v, *, causal: bool = False,
     mask = kv_mask if kv_mask is not None else jnp.ones((b, lk), jnp.float32)
     mask = mask.astype(jnp.float32)
 
+    if interpret and _join_vma(q, k, v, mask):
+        # inside shard_map on a non-TPU backend: Pallas's HLO interpreter
+        # cannot currently lower under vma checking, so run the pure-jnp
+        # kernel twin (identical math incl. NEG_INF/_TINY guards, and
+        # differentiable by plain AD).  The real kernel covers TPU and
+        # standalone-interpret tests; test_flash_block_primitives_match_
+        # kernel ties the two together.
+        out, _ = _fwd_block_ref(q, k, v, mask, scale, causal)
+        return out
+
     bq = min(block_q, lq)
     bk = min(block_k, lk)
     pad_q = (-lq) % bq
@@ -358,3 +388,149 @@ def flash_attention(q, k, v, *, causal: bool = False,
     if pad_q:
         out = out[:, :lq]
     return out
+
+
+# ---------------------------------------------------------------------------
+# blockwise primitives for ring attention (parallel/ring_attention.py)
+# ---------------------------------------------------------------------------
+#
+# The ring schedule needs the kernel's RAW outputs — per-block (out, lse) on
+# the forward, per-block (dq, dk, dv) given the GLOBAL lse/delta on the
+# backward — because the cross-block softmax merge and the cross-device
+# gradient accumulation happen at the ring layer, under its own custom_vjp.
+# These wrappers only adapt layouts ((B, L, H, D) model layout ↔ the
+# kernels' (B·H, L, D)) and handle block padding; they are NOT
+# differentiable entry points themselves.
+
+def _pad_seq(x, multiple):
+    pad = (-x.shape[1]) % multiple
+    if pad:
+        cfg = [(0, 0)] * x.ndim
+        cfg[1] = (0, pad)
+        x = jnp.pad(x, cfg)
+    return x, pad
+
+
+def _to_bh(x):
+    b, l, h, d = x.shape
+    return jnp.moveaxis(x, 2, 1).reshape(b * h, l, d)
+
+
+def _from_bh(x, b, h):
+    bh, l, d = x.shape
+    return jnp.moveaxis(x.reshape(b, h, l, d), 1, 2)
+
+
+def _block_scores_masked(q, k, kv_mask, scale, causal):
+    """f32 masked scores for one (q-block, k-block) pair, (B, H, Lq, Lk)."""
+    s = jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        qpos = jnp.arange(s.shape[-2])[:, None]
+        kpos = jnp.arange(s.shape[-1])[None, :]
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    return jnp.where(kv_mask[:, None, None, :] > 0, s, NEG_INF)
+
+
+def _fwd_block_ref(q, k, v, kv_mask, scale, causal):
+    """Pure-jnp twin of the forward kernel for one block pair — the
+    interpret-mode path: Pallas's HLO interpreter cannot currently lower
+    inside `jax.shard_map`'s vma checking, so CPU-mesh tests of the ring
+    schedule run this (bit-matching math incl. the NEG_INF/_TINY guards);
+    the real kernels cover the same math on TPU and standalone-interpret
+    tests (tests/test_flash_attention.py)."""
+    s = _block_scores_masked(q, k, kv_mask, scale, causal)
+    m = s.max(axis=-1)                                     # (B, H, Lq)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.maximum(p.sum(axis=-1), _TINY)
+    out = jnp.einsum("bhlm,bmhd->blhd", p, v.astype(jnp.float32))
+    out = out / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype), m + jnp.log(l)
+
+
+def _bwd_block_ref(q, k, v, kv_mask, do, lse, delta, scale, causal):
+    """Pure-jnp twin of the backward kernels for one block pair (see
+    _fwd_block_ref); p is recovered from the GLOBAL lse."""
+    s = _block_scores_masked(q, k, kv_mask, scale, causal)
+    p = jnp.exp(s - lse[..., None])                        # (B, H, Lq, Lk)
+    do32 = do.astype(jnp.float32)
+    dv = jnp.einsum("bhlm,blhd->bmhd", p, do32)
+    dp = jnp.einsum("blhd,bmhd->bhlm", do32, v.astype(jnp.float32))
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhlm,bmhd->blhd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bhlm,blhd->bmhd", ds, q.astype(jnp.float32))
+    return dq, dk, dv
+
+
+def flash_fwd_block(q, k, v, kv_mask, *, scale, causal=False,
+                    block_q: int = 512, block_k: int = 1024,
+                    interpret: bool | None = None):
+    """One flash forward over a (q-block, k-block) pair.
+
+    q: (B, Lq, H, D); k/v: (B, Lk, H, D); kv_mask: (B, Lk) (>0 valid).
+    Returns (out (B, Lq, H, D) in q.dtype, lse (B, H, Lq) f32).  ``causal``
+    means the pair sits on the ring's diagonal (identical global offsets);
+    off-diagonal causal blocks are entirely-past (causal=False) or
+    entirely-future (skipped by the caller)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if interpret:
+        return _fwd_block_ref(q, k, v, kv_mask, scale, causal)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    bq, bk = min(block_q, lq), min(block_k, lk)
+    q, pad_q = _pad_seq(q, bq)
+    k, _ = _pad_seq(k, bk)
+    v, pad_k = _pad_seq(v, bk)
+    mask = kv_mask.astype(jnp.float32)
+    if pad_k:
+        mask = jnp.pad(mask, ((0, 0), (0, pad_k)))
+    mask_bh = jnp.repeat(mask, h, axis=0)[:, None, :]
+    out, lse = _fwd(_to_bh(q), _to_bh(k), _to_bh(v), mask_bh,
+                    scale, causal, bq, bk, interpret)
+    out = _from_bh(out, b, h)[:, :lq]
+    lse = lse.reshape(b, h, lq + pad_q)[:, :, :lq]
+    return out, lse
+
+
+def flash_bwd_block(q, k, v, kv_mask, do, lse, delta, *, scale, causal=False,
+                    block_q: int = 512, block_k: int = 1024,
+                    interpret: bool | None = None):
+    """Per-block gradients given the GLOBAL softmax statistics.
+
+    lse/delta: (B, H, Lq) — log-sum-exp of the FULL row and Σ_d do·out of
+    the FULL output (flash's backward recovers this block's probabilities
+    as exp(s − lse)).  Returns (dq, dk, dv) in f32, each the contribution
+    of this (q-block, k-block) pair alone."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if interpret:
+        return _bwd_block_ref(q, k, v, kv_mask, do, lse, delta, scale,
+                              causal)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    bq, bk = min(block_q, lq), min(block_k, lk)
+    q, pad_q = _pad_seq(q, bq)
+    do, _ = _pad_seq(do, bq)
+    k, _ = _pad_seq(k, bk)
+    v, pad_k = _pad_seq(v, bk)
+    mask = kv_mask.astype(jnp.float32)
+    if pad_k:
+        mask = jnp.pad(mask, ((0, 0), (0, pad_k)))
+    if pad_q:
+        # padded q rows: lse NEG_INF ⇒ p = exp(s − (−∞)) would blow up;
+        # use +large lse instead so p underflows to 0 and contributes nothing
+        pad_rows = ((0, 0), (0, 0), (0, pad_q))
+        lse = jnp.pad(lse, pad_rows, constant_values=-NEG_INF)
+        delta = jnp.pad(delta, pad_rows)
+    mask_bh = jnp.repeat(mask, h, axis=0)[:, None, :]
+    lse_bh = lse.reshape(b * h, 1, lq + pad_q)
+    delta_bh = delta.astype(jnp.float32).reshape(b * h, 1, lq + pad_q)
+    dq, dk, dv = _bwd(
+        _to_bh(q).astype(jnp.float32), _to_bh(k).astype(jnp.float32),
+        _to_bh(v).astype(jnp.float32), mask_bh, lse_bh, delta_bh,
+        _to_bh(do).astype(jnp.float32), scale, causal, bq, bk, interpret)
+    dq = _from_bh(dq, b, h)[:, :lq]
+    dk = _from_bh(dk, b, h)[:, :lk]
+    dv = _from_bh(dv, b, h)[:, :lk]
+    return dq, dk, dv

@@ -90,3 +90,106 @@ def test_causal_first_block_fully_masked_is_safe(seq_mesh):
     q, k, v = qkv(3)
     got = run_sharded(ring_attention, seq_mesh, q, k, v, causal=True)
     assert np.isfinite(got).all()
+
+
+# ------------------------------------------------------------- ring + flash
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_dense(seq_mesh, causal):
+    """Ring schedule with the flash kernel as local block math (VERDICT r2
+    task 5).  On the CPU mesh the blocks run the pure-jnp kernel twin
+    (Pallas interpret mode cannot lower inside shard_map's vma checking);
+    the merge/schedule under test is identical either way."""
+    from distributed_tensorflow_tpu.parallel.ring_attention import (
+        ring_flash_attention)
+
+    q, k, v = qkv(4)
+    want = np.asarray(dense_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), causal=causal))
+    got = run_sharded(ring_flash_attention, seq_mesh, q, k, v, causal=causal)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_grads_match_dense(seq_mesh, causal):
+    """The hand-written ring backward (second ring pass with rotating dk/dv
+    accumulators, global lse/delta) must reproduce dense AD grads."""
+    from distributed_tensorflow_tpu.parallel.ring_attention import (
+        ring_flash_attention)
+
+    q, k, v = qkv(5)
+    rng = np.random.default_rng(6)
+    mask = (rng.random((B, L)) > 0.2).astype(np.float32)
+    mask[:, 0] = 1.0  # every row keeps at least one valid key
+    mask_j = jnp.asarray(mask)
+
+    def loss_dense(q, k, v):
+        return (dense_attention(q, k, v, causal=causal,
+                                kv_mask=mask_j) ** 2).sum()
+
+    def loss_ring(q, k, v):
+        smapped = jax.shard_map(
+            lambda a, b, c, m: ring_flash_attention(
+                a, b, c, axis="seq", causal=causal, kv_mask=m),
+            mesh=seq_mesh,
+            in_specs=(P(None, "seq"),) * 4,
+            out_specs=P(None, "seq"),
+        )
+        return (smapped(q, k, v, mask_j) ** 2).sum()
+
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b in zip(gd, gr):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_flash_block_primitives_match_kernel():
+    """The pure-jnp block twins (_fwd_block_ref/_bwd_block_ref) must agree
+    with the real Pallas kernels in interpret mode OUTSIDE shard_map — this
+    is the link that lets CPU ring tests certify the TPU kernel path."""
+    import importlib
+
+    # ops/__init__ re-exports the flash_attention FUNCTION under the same
+    # name, so `import ...ops.flash_attention as fa` binds the function
+    fa = importlib.import_module(
+        "distributed_tensorflow_tpu.ops.flash_attention")
+
+    rng = np.random.default_rng(7)
+    b, lq, lk, h, d = 2, 8, 16, 2, 8
+    q = jnp.asarray(rng.normal(size=(b, lq, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, lk, h, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, lk, h, d)).astype(np.float32))
+    mask = jnp.asarray((rng.random((b, lk)) > 0.2).astype(np.float32))
+    mask = mask.at[:, 0].set(1.0)
+    scale = d ** -0.5
+
+    ref_out, ref_lse = fa._fwd_block_ref(q, k, v, mask, scale, False)
+    # force the kernel path despite the CPU backend (interpret=True inside
+    # flash_fwd_block short-circuits to the ref; call the kernel directly)
+    out, lse = fa._fwd(fa._to_bh(q), fa._to_bh(k), fa._to_bh(v),
+                       jnp.repeat(mask, h, axis=0)[:, None, :],
+                       scale, False, lq, lk, True)
+    np.testing.assert_allclose(np.asarray(fa._from_bh(out, b, h)),
+                               np.asarray(ref_out), atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse.reshape(b, h, lq)),
+                               np.asarray(ref_lse), atol=2e-5, rtol=2e-5)
+
+    do = jnp.asarray(rng.normal(size=(b, lq, h, d)).astype(np.float32))
+    delta = jnp.sum(do * ref_out, axis=-1).transpose(0, 2, 1)
+    ref_dq, ref_dk, ref_dv = fa._bwd_block_ref(
+        q, k, v, mask, do, ref_lse, delta, scale, False)
+    dq, dk, dv = fa._bwd(
+        fa._to_bh(q), fa._to_bh(k), fa._to_bh(v),
+        jnp.repeat(mask, h, axis=0)[:, None, :],
+        ref_lse.reshape(b * h, 1, lq), delta.reshape(b * h, 1, lq),
+        fa._to_bh(do), scale, False, lq, lk, True)
+    np.testing.assert_allclose(np.asarray(fa._from_bh(dq, b, h)),
+                               np.asarray(ref_dq), atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(fa._from_bh(dk, b, h)),
+                               np.asarray(ref_dk), atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(fa._from_bh(dv, b, h)),
+                               np.asarray(ref_dv), atol=2e-4, rtol=2e-4)

@@ -113,3 +113,59 @@ def test_mesh_axis_validation():
         SeqParallelEngine(tiny_bert(), mesh=meshlib.create_mesh(8))
     with pytest.raises(ValueError):
         SeqParallelEngine(tiny_bert(), mesh=None)
+
+
+def test_seq_parallel_ring_flash_matches_single_device(text_data):
+    """ring_flash (ring schedule + flash local math, VERDICT r2 task 5)
+    must reproduce single-device dense training like plain ring does —
+    this exercises the custom_vjp ring backward through a real model."""
+    import optax
+
+    tr, _ = text_data
+    x, y = tr.x[:32], tr.y[:32]
+
+    eng1 = SyncEngine(tiny_bert("dense"), optimizer=optax.sgd(0.1),
+                      mesh=meshlib.create_mesh(1))
+    s1 = eng1.init_state(jax.random.key(0), x)
+    for _ in range(2):
+        xs, ys = eng1.shard_batch(x, y)
+        s1, m1 = eng1.step(s1, xs, ys)
+
+    eng8 = SeqParallelEngine(tiny_bert("ring_flash"), optimizer=optax.sgd(0.1),
+                             mesh=seq_mesh(2, 4))
+    s8 = eng8.init_state(jax.random.key(0), x)
+    for _ in range(2):
+        xs, ys = eng8.shard_batch(x, y)
+        s8, m8 = eng8.step(s8, xs, ys)
+
+    for a, b in zip(jax.tree.leaves(jax.device_get(s1.params)),
+                    jax.tree.leaves(jax.device_get(s8.params))):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-3)
+    assert float(m1["loss"]) == pytest.approx(float(m8["loss"]), abs=1e-4)
+
+
+def test_flash_attention_via_harness_dp_path(text_data):
+    """--attention flash at seq_parallel == 1 (VERDICT r2 task 2: the CLI
+    must be able to reach the Pallas kernel end-to-end)."""
+    from distributed_tensorflow_tpu.data.loaders import load_text_dataset
+    from distributed_tensorflow_tpu.utils.harness import ExperimentConfig, run
+
+    def dataset_fn(batch_size, type="train", **kw):
+        return load_text_dataset(seq_len=32, vocab_size=128, n_train=128,
+                                 n_test=64, split=type)
+
+    summary = run(ExperimentConfig(
+        engine="sync", model="bert_tiny", dataset="glue_synth",
+        attention_impl="flash", n_devices=8, batch_size=8, epochs=1,
+        log_every=0, dataset_fn=dataset_fn))
+    assert summary["engine"] == "sync"
+    assert np.isfinite(summary["test_loss"])
+
+
+def test_flash_attention_rejected_with_seq_parallel():
+    from distributed_tensorflow_tpu.utils.harness import ExperimentConfig, run
+
+    with pytest.raises(ValueError, match="ring_flash"):
+        run(ExperimentConfig(model="bert_tiny", dataset="glue_synth",
+                             attention_impl="flash", seq_parallel=4,
+                             n_devices=8))
