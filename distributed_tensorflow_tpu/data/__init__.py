@@ -41,7 +41,15 @@ def make_dataset_fn(name: str, **load_kw) -> Callable[..., Dataset]:
     ) -> Dataset:
         ds = load_dataset(name, split=type, reshape=reshape, **load_kw)
         if shard and n_shards > 1:
-            ds = ds.shard(n_shards, index)
+            import dataclasses
+
+            # even shards (all processes run the same batch count — uneven
+            # ones would wedge lock-step collectives) + the process_shard
+            # marker the Trainer reads to assemble global batches from
+            # process-local rows
+            ds = dataclasses.replace(
+                ds.shard(n_shards, index, even=True),
+                process_shard=(index, n_shards))
         ds = ds.with_batching(batch_size=batch_size, buffer_size=buffer_size)
         return ds
 

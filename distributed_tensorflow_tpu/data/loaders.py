@@ -39,15 +39,25 @@ class Dataset:
     synthetic: bool = False
     batch_size: int | None = None
     buffer_size: int = 10000
+    # (index, count) when this dataset is one PROCESS's shard of a larger
+    # logical dataset (multi-host input sharding): the Trainer then treats
+    # batches as process-local rows of a global batch (engines/allreduce.py)
+    process_shard: tuple[int, int] | None = None
 
     def __len__(self) -> int:
         return len(self.x)
 
-    def shard(self, n_shards: int, index: int) -> "Dataset":
-        """Every n-th example, like `tf.data .shard` (reference initializer.py:44)."""
-        return dataclasses.replace(
-            self, x=self.x[index::n_shards], y=self.y[index::n_shards]
-        )
+    def shard(self, n_shards: int, index: int, even: bool = False) -> "Dataset":
+        """Every n-th example, like `tf.data .shard` (reference initializer.py:44).
+
+        ``even=True`` truncates every shard to ``len // n_shards`` so all
+        shards are the same size — required when shards drive lock-step
+        SPMD processes (unequal batch counts would deadlock collectives)."""
+        x, y = self.x[index::n_shards], self.y[index::n_shards]
+        if even:
+            m = len(self.x) // n_shards
+            x, y = x[:m], y[:m]
+        return dataclasses.replace(self, x=x, y=y)
 
     def with_batching(self, batch_size: int, buffer_size: int = 10000) -> "Dataset":
         return dataclasses.replace(

@@ -38,7 +38,10 @@ class Trainer:
     def fit(self, train_ds, epochs: int = 1, batch_size: int | None = None,
             log_every: int = 50, log_fn: Callable[[str], None] = print,
             checkpoint_manager=None, checkpoint_every: int = 0,
-            metrics_logger=None, watchdog=None, nan_guard: bool = True) -> dict:
+            metrics_logger=None, watchdog=None, nan_guard: bool = True,
+            max_steps: int | None = None, eval_ds=None,
+            target_accuracy: float | None = None, eval_every: int = 50,
+            eval_batch: int = 100) -> dict:
         """Train; returns {'elapsed': seconds_around_fit, 'steps': n, ...} —
         the reference's only training metrics (reference dist_keras.py:41-49).
 
@@ -50,12 +53,33 @@ class Trainer:
         window and the watchdog's on_stall callback fires).
         ``nan_guard``: divergence check on metrics already materialized at
         the logging cadence (no extra device syncs; utils/failure.py).
+        ``max_steps``: hard step cap across epochs.  ``target_accuracy``
+        (with ``eval_ds``): early-stop when test accuracy reaches the
+        target — evaluated every ``eval_every`` steps far from the target
+        and every ≤10 steps once within 0.05 of it, so the steps-to-target
+        figure (BASELINE.md north star) has ≤10-step resolution without
+        paying full-eval cost on every step.  The result then carries
+        ``reached_target`` and ``eval_accuracy``.
         """
         from distributed_tensorflow_tpu.utils.failure import check_finite
         eng = self.engine
         bs = batch_size or train_ds.batch_size or 32
         bs = max(bs, eng.n_devices)
         bs = (bs // eng.n_devices) * eng.n_devices
+        # process-sharded input (multi-host): this process's dataset holds
+        # 1/P of the examples, so it iterates LOCAL batches of bs/P rows and
+        # each step's global batch is assembled from every process's rows
+        # (Engine.shard_batch process_local).  Shards are even (.shard
+        # even=True), so all processes run the same number of steps — a
+        # batch-count mismatch would wedge the collectives.
+        shard = getattr(train_ds, "process_shard", None)
+        n_procs = shard[1] if shard else 1
+        if n_procs > 1:
+            if bs % n_procs:
+                bs = (bs // n_procs) * n_procs or n_procs
+            local_bs = bs // n_procs
+        else:
+            local_bs = bs
         if self.state is None:
             rng = jax.random.key(self.seed)
             sample = train_ds.x[: max(1, eng.n_devices)]
@@ -71,12 +95,20 @@ class Trainer:
         examples = 0
         last_metrics = {}
         in_flight: list = []
+        eval_acc = 0.0
+        reached = False
+        stop = False
+        prev_eval_step = 0   # step of the eval BEFORE the current one —
+        eval_gap = None      # the honest resolution of a reached target
         for epoch in range(epochs):
+            if stop:
+                break
             for bx, by, _ in train_ds.batches(
-                    bs, shuffle=True, seed=self.seed, epoch=epoch,
+                    local_bs, shuffle=True, seed=self.seed, epoch=epoch,
                     drop_remainder=True):
                 with timer:  # amortized dispatch+throttle time (see result)
-                    xs, ys = self.engine.shard_batch(bx, by)
+                    xs, ys = self.engine.shard_batch(
+                        bx, by, process_local=n_procs > 1)
                     self.state, metrics = eng.step(self.state, xs, ys)
                     in_flight.append(metrics)
                     if len(in_flight) > self.max_in_flight:
@@ -89,7 +121,7 @@ class Trainer:
                     watchdog.beat()
                 steps += 1
                 gstep = start_step + steps
-                examples += len(bx)
+                examples += len(bx) * n_procs  # global examples per step
                 if metrics_logger is not None and metrics_logger.should_log(gstep):
                     # throttle-check BEFORE float(): forcing device values
                     # every step would sync the host into the pipeline that
@@ -111,6 +143,31 @@ class Trainer:
                     last_metrics = m
                     # progress heartbeat — parity with reference client.py:92-94
                     log_fn(f"step {gstep}  loss {m['loss']:.4f}  acc {m['accuracy']:.4f}")
+                at_cap = max_steps is not None and steps >= max_steps
+                if target_accuracy is not None and eval_ds is not None:
+                    # fine cadence when the answer could be near: the first
+                    # window (fast-saturating tasks cross before a coarse
+                    # first eval) and once accuracy is within 0.05 of the
+                    # target; coarse in between.  Always evaluate on the
+                    # final step so hitting max_steps can't return a stale
+                    # (or never-computed) accuracy.
+                    near = (eval_acc >= target_accuracy - 0.05
+                            or steps <= eval_every)
+                    cadence = min(eval_every, 10) if near else eval_every
+                    if steps % max(cadence, 1) == 0 or at_cap:
+                        gap = steps - prev_eval_step
+                        prev_eval_step = steps
+                        eval_acc = self.evaluate(
+                            eval_ds, batch_size=eval_batch)["accuracy"]
+                        if eval_acc >= target_accuracy:
+                            # the crossing lies somewhere in the gap since
+                            # the previous eval — report THAT as resolution
+                            eval_gap = gap
+                            reached = stop = True
+                            break
+                if at_cap:
+                    stop = True
+                    break
         jax.block_until_ready(self.state)
         if nan_guard and steps:
             final = {k: float(v) for k, v in metrics.items()}
@@ -123,6 +180,9 @@ class Trainer:
             "elapsed": elapsed, "steps": steps, "epochs": epochs,
             "start_step": start_step, "examples": examples,
             "examples_per_sec": examples / elapsed if elapsed > 0 else 0.0,
+            **({"reached_target": reached, "eval_accuracy": eval_acc,
+                "eval_resolution": eval_gap}
+               if target_accuracy is not None else {}),
             # per-step wall times: first_step_s isolates XLA compile; steady
             # percentiles measure dispatch pace (device-throughput-bound once
             # the max_in_flight window fills)

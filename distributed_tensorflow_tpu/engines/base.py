@@ -84,18 +84,31 @@ class Engine:
         return meshlib.state_to_global(state, meshlib.replicated(self.mesh))
 
     # ------------------------------------------------------------- batches
-    def shard_batch(self, x: np.ndarray, y: np.ndarray, mask: np.ndarray | None = None):
-        """Place a global batch with its leading dim split over the data axis.
+    def _place(self, arr, sharding, process_local: bool):
+        """One batch-array placement: full-host copy or process-local rows."""
+        if process_local:
+            return meshlib.local_to_global(arr, sharding)
+        return meshlib.host_to_global(arr, sharding)
 
-        Replaces per-worker dataset sharding (reference initializer.py:44):
-        one host batch feeds all devices.
+    def shard_batch(self, x: np.ndarray, y: np.ndarray,
+                    mask: np.ndarray | None = None,
+                    process_local: bool = False):
+        """Place a batch with its leading dim split over the data axis.
+
+        ``process_local=False``: every process passes the same global batch
+        (one host batch feeds all devices).  ``process_local=True``: each
+        process passes its OWN rows (global_batch / process_count of them)
+        from its input shard — the multi-host rendering of the reference's
+        per-worker dataset sharding (reference initializer.py:44).
         """
-        xs = meshlib.host_to_global(x, meshlib.data_sharding(self.mesh, x.ndim))
-        ys = meshlib.host_to_global(y, meshlib.data_sharding(self.mesh, y.ndim))
+        xs = self._place(x, meshlib.data_sharding(self.mesh, x.ndim),
+                         process_local)
+        ys = self._place(y, meshlib.data_sharding(self.mesh, y.ndim),
+                         process_local)
         if mask is None:
             return xs, ys
-        ms = meshlib.host_to_global(mask,
-                                    meshlib.data_sharding(self.mesh, mask.ndim))
+        ms = self._place(mask, meshlib.data_sharding(self.mesh, mask.ndim),
+                         process_local)
         return xs, ys, ms
 
     # ---------------------------------------------------------------- step

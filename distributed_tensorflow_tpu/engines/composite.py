@@ -84,7 +84,7 @@ class CompositeEngine(Engine):
         return self._init_partitioned_state(rng, sample_x, init_model=twin)
 
     # --------------------------------------------------------------- batches
-    def shard_batch(self, x, y, mask=None):
+    def shard_batch(self, x, y, mask=None, process_local=False):
         if self._manual_seq:
             if x.ndim < 2:
                 raise ValueError("seq sharding needs (batch, seq, ...) input")
@@ -93,12 +93,13 @@ class CompositeEngine(Engine):
                                  f"by seq axis size {self.seq_n}")
         xspec = (P(self.axis, self.seq_axis) if self._manual_seq
                  else P(self.axis, *([None] * (x.ndim - 1))))
-        xs = meshlib.host_to_global(x, NamedSharding(self.mesh, xspec))
-        ys = meshlib.host_to_global(y, NamedSharding(self.mesh, P(self.axis)))
+        xs = self._place(x, NamedSharding(self.mesh, xspec), process_local)
+        ys = self._place(y, NamedSharding(self.mesh, P(self.axis)),
+                         process_local)
         if mask is None:
             return xs, ys
-        ms = meshlib.host_to_global(mask,
-                                    NamedSharding(self.mesh, P(self.axis)))
+        ms = self._place(mask, NamedSharding(self.mesh, P(self.axis)),
+                         process_local)
         return xs, ys, ms
 
     # ------------------------------------------------------------------ step

@@ -56,12 +56,12 @@ class ExpertParallelEngine(Engine):
                  *([None] * (ndim - 1)))
         return NamedSharding(self.mesh, spec)
 
-    def shard_batch(self, x, y, mask=None):
-        xs = meshlib.host_to_global(x, self._batch_sharding(x.ndim))
-        ys = meshlib.host_to_global(y, self._batch_sharding(y.ndim))
+    def shard_batch(self, x, y, mask=None, process_local=False):
+        xs = self._place(x, self._batch_sharding(x.ndim), process_local)
+        ys = self._place(y, self._batch_sharding(y.ndim), process_local)
         if mask is None:
             return xs, ys
-        ms = meshlib.host_to_global(mask, self._batch_sharding(mask.ndim))
+        ms = self._place(mask, self._batch_sharding(mask.ndim), process_local)
         return xs, ys, ms
 
     def init_state(self, rng, sample_x) -> TrainState:

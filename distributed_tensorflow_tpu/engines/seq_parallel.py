@@ -72,15 +72,16 @@ class SeqParallelEngine(Engine):
                            opt_state=opt_state, rng=rng)
         return meshlib.state_to_global(state, meshlib.replicated(self.mesh))
 
-    def shard_batch(self, x, y, mask=None):
-        xs = meshlib.host_to_global(x, NamedSharding(
-            self.mesh, P(meshlib.DATA_AXIS, meshlib.SEQ_AXIS)))
-        ys = meshlib.host_to_global(
-            y, NamedSharding(self.mesh, P(meshlib.DATA_AXIS)))
+    def shard_batch(self, x, y, mask=None, process_local=False):
+        xs = self._place(x, NamedSharding(
+            self.mesh, P(meshlib.DATA_AXIS, meshlib.SEQ_AXIS)), process_local)
+        ys = self._place(
+            y, NamedSharding(self.mesh, P(meshlib.DATA_AXIS)), process_local)
         if mask is None:
             return xs, ys
-        ms = meshlib.host_to_global(
-            mask, NamedSharding(self.mesh, P(meshlib.DATA_AXIS)))
+        ms = self._place(
+            mask, NamedSharding(self.mesh, P(meshlib.DATA_AXIS)),
+            process_local)
         return xs, ys, ms
 
     def _build_step(self):

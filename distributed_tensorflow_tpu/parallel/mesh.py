@@ -115,6 +115,19 @@ def host_to_global(arr, sharding: NamedSharding):
         np.shape(arr), sharding, lambda idx: arr[idx])
 
 
+def local_to_global(arr, sharding: NamedSharding):
+    """Place a PROCESS-LOCAL array as this process's portion of a global
+    array (each process contributes different rows — the multi-host input
+    sharding the reference gets from per-worker `.shard(n_nodes, index)`,
+    reference initializer.py:44).  Contrast `host_to_global`, which assumes
+    every process holds the same full array."""
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(arr))
+
+
 def state_to_global(tree, shardings):
     """Place a pytree of device values (identical on every process) onto the
     mesh with the given sharding(s).

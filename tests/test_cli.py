@@ -135,6 +135,23 @@ def test_steps_to_accuracy_step_granularity():
     assert r["reached"], r
     assert r["steps"] % 8 == 0  # eval cadence honored
     assert r["steps"] < 300
+    # resolution is MEASURED (gap between the crossing eval and the one
+    # before), labeled synthetic, and routed through the one Trainer loop
+    assert r["step_resolution"] <= 8
+    assert r["synthetic"] is True
+
+
+def test_steps_to_accuracy_max_steps_final_eval():
+    """Hitting max_steps must still report a real (final-step) accuracy,
+    never a stale or never-computed one (review r3 finding)."""
+    from distributed_tensorflow_tpu.utils.harness import ExperimentConfig, steps_to_accuracy
+
+    cfg = ExperimentConfig(engine="sync", model="mlp", dataset="synthetic",
+                           n_devices=8, batch_size=16)
+    r = steps_to_accuracy(cfg, target=1.01, max_steps=7, eval_every=50)
+    assert not r["reached"]
+    assert r["steps"] == 7
+    assert r["accuracy"] > 0.0  # the cap-step eval ran
 
 
 def test_cli_user_plugin_model_and_dataset_fn():
