@@ -115,6 +115,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="MoE expert count (must divide by -ep)")
     p.add_argument("--aux-weight", type=float, default=0.01,
                    help="MoE load-balance auxiliary loss weight")
+    p.add_argument("--router-top-k", type=int, default=1, choices=[1, 2],
+                   help="MoE routing: 1 = Switch top-1, 2 = GShard top-2 "
+                        "(renormalized gates, priority capacity positions)")
+    p.add_argument("--router-z-weight", type=float, default=0.0,
+                   help="MoE router z-loss weight (0 disables; ~1e-3 "
+                        "stabilizes router logits on long runs)")
     p.add_argument("--result-path", default=None, help="JSONL event sink path")
     p.add_argument("--supervisor", default=None, metavar="HOST[:PORT]",
                    help="report the reference's start/done/results event "
@@ -221,6 +227,8 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         expert_parallel=args.expert_parallel,
         num_experts=args.num_experts,
         aux_weight=args.aux_weight,
+        router_top_k=args.router_top_k,
+        router_z_weight=args.router_z_weight,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,

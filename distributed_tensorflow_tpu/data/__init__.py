@@ -38,18 +38,19 @@ def make_dataset_fn(name: str, **load_kw) -> Callable[..., Dataset]:
         buffer_size: int = 10000,
         reshape: bool = True,
         n_shards: int = 1,
+        process: bool = False,
     ) -> Dataset:
         ds = load_dataset(name, split=type, reshape=reshape, **load_kw)
         if shard and n_shards > 1:
-            import dataclasses
-
-            # even shards (all processes run the same batch count — uneven
-            # ones would wedge lock-step collectives) + the process_shard
-            # marker the Trainer reads to assemble global batches from
-            # process-local rows
-            ds = dataclasses.replace(
-                ds.shard(n_shards, index, even=True),
-                process_shard=(index, n_shards))
+            if process:
+                # one shard PER JAX PROCESS feeding lock-step training:
+                # even shards + the process_shard marker the Trainer reads
+                # to assemble global batches from local rows.  n_shards
+                # must equal jax.process_count() (the Trainer validates).
+                ds = ds.process_shard_of(n_shards, index)
+            else:
+                # reference semantics: every n-th example, no truncation
+                ds = ds.shard(n_shards, index)
         ds = ds.with_batching(batch_size=batch_size, buffer_size=buffer_size)
         return ds
 

@@ -59,6 +59,16 @@ class Dataset:
             x, y = x[:m], y[:m]
         return dataclasses.replace(self, x=x, y=y)
 
+    def process_shard_of(self, n_procs: int, index: int) -> "Dataset":
+        """This process's shard for multi-host training: EVEN shards (all
+        processes must run the same batch count — uneven ones would wedge
+        lock-step collectives) plus the ``process_shard`` marker the
+        Trainer reads to assemble global batches from process-local rows.
+        The two must always travel together; use this, not bare shard()."""
+        return dataclasses.replace(
+            self.shard(n_procs, index, even=True),
+            process_shard=(index, n_procs))
+
     def with_batching(self, batch_size: int, buffer_size: int = 10000) -> "Dataset":
         return dataclasses.replace(
             self, batch_size=batch_size, buffer_size=buffer_size

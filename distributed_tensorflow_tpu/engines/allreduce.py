@@ -10,6 +10,7 @@ the reference's elapsed metric (reference dist_keras.py:41-43).
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Callable
 
@@ -62,6 +63,9 @@ class Trainer:
         ``reached_target`` and ``eval_accuracy``.
         """
         from distributed_tensorflow_tpu.utils.failure import check_finite
+        if target_accuracy is not None and eval_ds is None:
+            raise ValueError("target_accuracy requires eval_ds (nothing "
+                             "would ever be evaluated against the target)")
         eng = self.engine
         bs = batch_size or train_ds.batch_size or 32
         bs = max(bs, eng.n_devices)
@@ -75,8 +79,20 @@ class Trainer:
         shard = getattr(train_ds, "process_shard", None)
         n_procs = shard[1] if shard else 1
         if n_procs > 1:
+            if n_procs != jax.process_count():
+                # a mismatched shard count would feed
+                # make_array_from_process_local_data wrongly-sized rows
+                # (multi-process) or silently shrink the global batch to
+                # one shard (single-process)
+                raise ValueError(
+                    f"dataset is sharded {n_procs} ways but this job has "
+                    f"{jax.process_count()} process(es); shard with "
+                    f"n_shards == process_count (Dataset.process_shard_of)")
             if bs % n_procs:
-                bs = (bs // n_procs) * n_procs or n_procs
+                # keep BOTH divisibilities: round to a multiple of
+                # lcm(n_devices, n_procs) so per-device sharding survives
+                unit = math.lcm(eng.n_devices, n_procs)
+                bs = max((bs // unit) * unit, unit)
             local_bs = bs // n_procs
         else:
             local_bs = bs
@@ -168,6 +184,15 @@ class Trainer:
                 if at_cap:
                     stop = True
                     break
+        if (target_accuracy is not None and eval_ds is not None
+                and not reached and steps and prev_eval_step != steps):
+            # loop ended by exhausting epochs (not the cap): still finish
+            # with a real eval so eval_accuracy is never stale/uncomputed
+            eval_gap = steps - prev_eval_step
+            eval_acc = self.evaluate(eval_ds, batch_size=eval_batch)["accuracy"]
+            reached = eval_acc >= target_accuracy
+            if not reached:
+                eval_gap = None
         jax.block_until_ready(self.state)
         if nan_guard and steps:
             final = {k: float(v) for k, v in metrics.items()}

@@ -8,8 +8,11 @@ XLA GSPMD lowers the dispatch/combine einsums to the all-to-alls that carry
 token slots to their expert's device over ICI.
 
 Loss = task cross-entropy + ``aux_weight`` × the Switch load-balancing
-auxiliary loss the model sows into ``intermediates`` — without it top-1
-routing collapses onto a few experts.
+auxiliary loss + ``router_z_weight`` × the router z-loss, both sown by the
+model into ``intermediates`` — without the balance loss top-1 routing
+collapses onto a few experts.  The per-step metrics carry ``overflow``
+(fraction of routing assignments dropped at capacity), so router collapse
+is observable directly instead of as silent accuracy loss.
 """
 
 from __future__ import annotations
@@ -24,10 +27,28 @@ from distributed_tensorflow_tpu.engines.base import (
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
 
-def _sum_aux(intermediates) -> jax.Array:
-    """Total of every sown aux_loss (one per MoE layer)."""
-    leaves = jax.tree.leaves(intermediates)
-    return sum(leaves, jnp.zeros((), jnp.float32))
+def _collect(intermediates, name: str) -> list[jax.Array]:
+    """Leaves sown under ``name`` (one per MoE layer) — the layers sow
+    several diagnostics (aux_loss, z_loss, overflow), so summing ALL
+    leaves would silently mix them."""
+    out = []
+
+    def visit(path, leaf):
+        if any(isinstance(k, jax.tree_util.DictKey) and k.key == name
+               for k in path):
+            out.append(leaf)
+
+    jax.tree_util.tree_map_with_path(visit, intermediates)
+    return out
+
+
+def _sum_named(intermediates, name: str) -> jax.Array:
+    return sum(_collect(intermediates, name), jnp.zeros((), jnp.float32))
+
+
+def _mean_named(intermediates, name: str) -> jax.Array:
+    leaves = _collect(intermediates, name)
+    return (_sum_named(intermediates, name) / max(len(leaves), 1))
 
 
 class ExpertParallelEngine(Engine):
@@ -38,12 +59,13 @@ class ExpertParallelEngine(Engine):
     """
 
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
-                 aux_weight: float = 0.01):
+                 aux_weight: float = 0.01, router_z_weight: float = 0.0):
         if mesh is None or set(mesh.axis_names) != {meshlib.DATA_AXIS,
                                                     meshlib.EXPERT_AXIS}:
             raise ValueError(
                 "ExpertParallelEngine requires a ('data','expert') mesh")
         self.aux_weight = aux_weight
+        self.router_z_weight = router_z_weight
         super().__init__(model, optimizer, mesh, learning_rate)
         # tokens shard over the WHOLE mesh (see shard_batch), so batch
         # divisibility is against every device, not just the data axis
@@ -69,7 +91,8 @@ class ExpertParallelEngine(Engine):
 
     def _build_step(self):
         apply_fn = self.model.apply
-        tx, aux_weight = self.tx, self.aux_weight
+        tx = self.tx
+        aux_weight, z_weight = self.aux_weight, self.router_z_weight
 
         def train_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
@@ -78,18 +101,27 @@ class ExpertParallelEngine(Engine):
                 logits, col = apply_fn(
                     {"params": params}, x, train=True,
                     rngs={"dropout": rng}, mutable=["intermediates"])
+                inter = col["intermediates"]
                 task = cross_entropy(logits, y).mean()
-                aux = _sum_aux(col["intermediates"])
+                aux = _sum_named(inter, "aux_loss")
+                z = _sum_named(inter, "z_loss")
+                # overflow is a diagnostic, not a loss: the fraction of
+                # routing assignments dropped at capacity — a collapsed
+                # router is visible here instead of as silent accuracy loss
+                overflow = jax.lax.stop_gradient(
+                    _mean_named(inter, "overflow"))
                 acc = (logits.argmax(-1) == y).mean()
-                return task + aux_weight * aux, (task, acc)
+                return (task + aux_weight * aux + z_weight * z,
+                        (task, acc, overflow))
 
-            (loss, (task, acc)), grads = jax.value_and_grad(
+            (loss, (task, acc, overflow)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             return state.replace(step=state.step + 1, params=params,
                                  opt_state=opt_state), \
-                {"loss": task, "accuracy": acc, "total_loss": loss}
+                {"loss": task, "accuracy": acc, "total_loss": loss,
+                 "overflow": overflow}
 
         # jit semantics are global; GSPMD inserts the expert all-to-alls
         return jax.jit(train_step, donate_argnums=0)

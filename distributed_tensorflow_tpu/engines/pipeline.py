@@ -97,15 +97,46 @@ def _match_vma(a, b):
 
 
 def _pipe_spec_tree(tree):
-    """PartitionSpec tree: leaves under a 'blocks' dict key are stage-stacked
-    → sharded P('pipe') on the leading (stage) dim; everything else
-    replicated.  Works for params AND optimizer state (optax mu/nu mirror the
-    param tree, so their paths also contain the 'blocks' key)."""
+    """MANUAL-axes PartitionSpec tree (shard_map in/out_specs): leaves under
+    a 'blocks' dict key are stage-stacked → sharded P('pipe') on the leading
+    (stage) dim; everything else replicated over the manual axes.  Works for
+    params AND optimizer state (optax mu/nu mirror the param tree, so their
+    paths also contain the 'blocks' key).  Model-axis (TP) sharding is NOT
+    expressed here — it lives on the arrays themselves and GSPMD handles it
+    as an auto axis (see _full_spec_tree)."""
 
     def spec(path, leaf):
         for k in path:
             if isinstance(k, jax.tree_util.DictKey) and k.key == "blocks":
                 return P(meshlib.PIPE_AXIS)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
+def _full_spec_tree(tree, stage_specs: dict):
+    """FULL PartitionSpec tree for array placement at init: combines the
+    pipe stacking with each stage's Megatron annotations.  ``stage_specs``
+    maps 'embed'/'blocks'/'head' to that stage's annotation-derived spec
+    subtree ('blocks' entries already carry the leading 'pipe' dim).
+    Optimizer state resolves through the same lookup because optax mu/nu
+    mirror the param tree paths."""
+
+    def lookup(sub, remainder):
+        for k in remainder:
+            if (isinstance(k, jax.tree_util.DictKey) and isinstance(sub, dict)
+                    and k.key in sub):
+                sub = sub[k.key]
+            else:
+                return None
+        return sub if isinstance(sub, P) else None
+
+    def spec(path, leaf):
+        for i, k in enumerate(path):
+            if isinstance(k, jax.tree_util.DictKey) and k.key in stage_specs:
+                s = lookup(stage_specs[k.key], path[i + 1:])
+                if s is not None:
+                    return s
         return P()
 
     return jax.tree_util.tree_map_with_path(spec, tree)
@@ -158,13 +189,24 @@ class PipelineEngine(Engine):
         stages: tuple[nn.Module, nn.Module, nn.Module] | None = None,
         schedule: str = "gpipe",
     ):
-        if mesh is None or set(mesh.axis_names) != {meshlib.DATA_AXIS,
-                                                    meshlib.PIPE_AXIS}:
+        if mesh is None or not {meshlib.DATA_AXIS,
+                                meshlib.PIPE_AXIS} <= set(mesh.axis_names):
             raise ValueError("PipelineEngine requires a ('data','pipe') mesh")
+        extra = set(mesh.axis_names) - {meshlib.DATA_AXIS, meshlib.PIPE_AXIS,
+                                        meshlib.MODEL_AXIS}
+        if extra:
+            raise ValueError(
+                f"unsupported mesh axes {sorted(extra)}; PipelineEngine "
+                f"composes data×pipe(×model)")
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown schedule '{schedule}'; "
                              f"choose 'gpipe' or '1f1b'")
         self.schedule = schedule
+        # optional Megatron TP inside each stage: 'model' is a GSPMD auto
+        # axis — the shard_map is manual over (data, pipe) only, and the
+        # stage params' with_partitioning annotations drive the in-stage
+        # model-axis collectives (pp×tp)
+        self.tp_n = mesh.shape.get(meshlib.MODEL_AXIS, 1)
         if stages is not None:
             self.embed, self.block, self.head = stages
         else:
@@ -181,18 +223,35 @@ class PipelineEngine(Engine):
     def init_state(self, rng, sample_x) -> TrainState:
         x = jnp.asarray(sample_x[:1])
         e_rng, b_rng, h_rng = jax.random.split(rng, 3)
-        embed_p = self.embed.init(e_rng, x)["params"]
+        embed_v = self.embed.init(e_rng, x)
+        embed_p = nn.unbox(embed_v)["params"]
         h = self.embed.apply({"params": embed_p}, x)
         blocks_p = jax.vmap(
-            lambda k: self.block.init(k, h)["params"]
+            lambda k: nn.unbox(self.block.init(k, h))["params"]
         )(jax.random.split(b_rng, self.n_stages))
-        head_p = self.head.init(h_rng, h)["params"]
+        head_v = self.head.init(h_rng, h)
+        head_p = nn.unbox(head_v)["params"]
         params = {"embed": embed_p, "blocks": blocks_p, "head": head_p}
         opt_state = self.tx.init(params)
         state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                            opt_state=opt_state, rng=rng)
+        # full placement specs: pipe stacking (+ per-stage Megatron
+        # annotations when the stages carry them).  A single un-stacked
+        # block init supplies the annotation specs; the stacked leaves get
+        # 'pipe' prepended.
+        block_abs = jax.eval_shape(lambda k: self.block.init(k, h),
+                                   jax.random.key(0))
+        block_ann = nn.get_partition_spec(block_abs)["params"]
+        stage_specs = {
+            "embed": nn.get_partition_spec(embed_v)["params"],
+            "head": nn.get_partition_spec(head_v)["params"],
+            "blocks": jax.tree.map(
+                lambda s: P(meshlib.PIPE_AXIS, *s), block_ann,
+                is_leaf=lambda s: isinstance(s, P)),
+        }
         shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), _pipe_spec_tree(state),
+            lambda s: NamedSharding(self.mesh, s),
+            _full_spec_tree(state, stage_specs),
             is_leaf=lambda x: isinstance(x, P))
         return meshlib.state_to_global(state, shardings)
 
@@ -524,19 +583,28 @@ class PipelineEngine(Engine):
     def _wrap_pipe_step(self, device_step):
         """Lazy shard_map+jit wrapper shared by both schedules: the in/out
         spec trees depend on the concrete state structure, so the shard_map
-        is built on first call.  The jit is kept on ``self._jit_step`` so
-        tests can inspect the compiled HLO (e.g. assert embed/head sit
-        behind `conditional`s)."""
+        is built on first call.  With a 'model' mesh axis the shard_map is
+        PARTIAL-manual — manual over (data, pipe) so the schedule's
+        ppermute ring is explicit, auto over 'model' so GSPMD inserts the
+        Megatron collectives inside each stage (every model-axis peer holds
+        the same stage index, so per-device `lax.cond` branching stays
+        uniform along the auto axis and its collectives cannot deadlock).
+        The jit is kept on ``self._jit_step`` so tests can inspect the
+        compiled HLO (e.g. assert embed/head sit behind `conditional`s)."""
         compiled = {}
+        manual = {meshlib.DATA_AXIS, meshlib.PIPE_AXIS}
 
         def step_fn(state, x, y):
             if "fn" not in compiled:
                 spec = _pipe_spec_tree(state)
+                kw = ({"axis_names": manual}
+                      if meshlib.MODEL_AXIS in self.mesh.axis_names else {})
                 smapped = jax.shard_map(
                     device_step, mesh=self.mesh,
                     in_specs=(spec, P(meshlib.DATA_AXIS),
                               P(meshlib.DATA_AXIS)),
                     out_specs=(spec, P()),
+                    **kw,
                 )
                 compiled["fn"] = self._jit_step = jax.jit(
                     smapped, donate_argnums=0)

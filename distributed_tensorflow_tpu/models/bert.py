@@ -246,6 +246,7 @@ class BertPipeEmbed(nn.Module):
     vocab_size: int = 8192
     hidden: int = 128
     max_len: int = 512
+    partition_model: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -257,18 +258,24 @@ class BertPipeEmbed(nn.Module):
                 f"max_len={self.max_len}")
         pos = jnp.arange(token_ids.shape[1])[None, :]
         x = BertEmbeddings(self.vocab_size, self.hidden, self.max_len,
-                           dtype=self.dtype)(token_ids, pos)
+                           self.partition_model, dtype=self.dtype)(
+                               token_ids, pos)
         return x, pad_mask
 
 
 class BertPipeBlock(nn.Module):
     """One pipeline stage: ``layers_per_stage`` transformer layers
-    (hidden-preserving, so stages stack and shard P('pipe'))."""
+    (hidden-preserving, so stages stack and shard P('pipe')).
+
+    ``partition_model=True`` adds the Megatron annotations for pp×tp: the
+    stacked stage params then shard ('pipe', …Megatron spec…) and GSPMD
+    owns the in-stage model-axis collectives (engines/pipeline.py)."""
 
     hidden: int = 128
     heads: int = 2
     ffn: int = 512
     layers_per_stage: int = 1
+    partition_model: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -277,6 +284,7 @@ class BertPipeBlock(nn.Module):
         for _ in range(self.layers_per_stage):
             x = TransformerLayer(self.hidden, self.heads, self.ffn,
                                  dropout_rate=0.0, attention_impl="dense",
+                                 partition_model=self.partition_model,
                                  dtype=self.dtype)(x, pad_mask)
         return x, pad_mask
 
@@ -302,14 +310,17 @@ def bert_pipeline_stages(
     ffn: int = 512,
     max_len: int = 512,
     layers_per_stage: int = 1,
+    partition_model: bool = False,
     dtype: jnp.dtype = jnp.float32,
 ):
     """(embed, block, head) for ``PipelineEngine(stages=...)``: a BERT
-    encoder of depth ``pipe_axis_size × layers_per_stage``."""
+    encoder of depth ``pipe_axis_size × layers_per_stage``.
+    ``partition_model=True`` adds Megatron TP annotations for pp×tp."""
     return (
         BertPipeEmbed(vocab_size=vocab_size, hidden=hidden, max_len=max_len,
-                      dtype=dtype),
+                      partition_model=partition_model, dtype=dtype),
         BertPipeBlock(hidden=hidden, heads=heads, ffn=ffn,
-                      layers_per_stage=layers_per_stage, dtype=dtype),
+                      layers_per_stage=layers_per_stage,
+                      partition_model=partition_model, dtype=dtype),
         BertPipeHead(num_classes=num_classes, hidden=hidden, dtype=dtype),
     )

@@ -33,7 +33,19 @@ from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
 
 class MoELayer(nn.Module):
-    """Top-1 routed expert FFN over tokens (leading axis of x).
+    """Top-k (k ∈ {1, 2}) routed expert FFN over tokens (leading axis of x).
+
+    ``router_top_k=1`` is Switch routing; ``2`` is GShard-style top-2 with
+    renormalized gates and priority positions (top-1 assignments claim
+    capacity slots before any top-2 assignment).  The layer sows, per
+    call:
+      * ``aux_loss``  — Switch load-balance loss (token fraction × mean
+        router prob, over top-1 choices);
+      * ``z_loss``    — router logit z-loss, mean(logsumexp(logits)²)
+        (stabilizes router logits; weighted by the engine);
+      * ``overflow``  — fraction of (token, choice) assignments dropped at
+        the capacity limit.  A collapsed router shows up HERE, not as a
+        mysterious accuracy loss: dropped tokens pass through the residual.
 
     ``partition_experts`` adds the ``with_partitioning('expert', ...)``
     annotations the expert-parallel engine reads; leave False on meshes
@@ -44,33 +56,72 @@ class MoELayer(nn.Module):
     num_experts: int = 8
     hidden: int = 256
     capacity_factor: float = 1.25
+    router_top_k: int = 1
     partition_experts: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
+        if self.router_top_k not in (1, 2):
+            raise ValueError(
+                f"router_top_k must be 1 or 2, got {self.router_top_k}")
         tokens, d = x.shape
         e = self.num_experts
-        capacity = max(1, int(self.capacity_factor * tokens / e + 0.999999))
+        # capacity scales with k (GShard): top-2 makes 2·tokens assignments,
+        # so unscaled slots would drop ≥37% even under perfectly uniform
+        # routing and the overflow metric would read ~0.4 forever
+        capacity = max(1, int(self.router_top_k * self.capacity_factor
+                              * tokens / e + 0.999999))
 
         # --- router (f32) ------------------------------------------------
         gate_w = self.param("gate", nn.initializers.lecun_normal(), (d, e),
                             jnp.float32)
-        probs = jax.nn.softmax(x.astype(jnp.float32) @ gate_w, axis=-1)
+        logits = x.astype(jnp.float32) @ gate_w
+        probs = jax.nn.softmax(logits, axis=-1)
         top1 = jnp.argmax(probs, axis=-1)                       # [T]
-        mask = jax.nn.one_hot(top1, e, dtype=jnp.float32)       # [T, E]
+        mask1 = jax.nn.one_hot(top1, e, dtype=jnp.float32)      # [T, E]
 
         # Switch aux loss: E · Σ_e (token fraction · mean router prob)
-        aux = e * jnp.sum(mask.mean(axis=0) * probs.mean(axis=0))
+        aux = e * jnp.sum(mask1.mean(axis=0) * probs.mean(axis=0))
         self.sow("intermediates", "aux_loss", aux)
+        # router z-loss: keeps logits from drifting to magnitudes where
+        # softmax saturates and routing gradients vanish
+        self.sow("intermediates", "z_loss",
+                 jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2))
 
         # --- capacity-limited dispatch/combine tensors -------------------
-        position = (jnp.cumsum(mask, axis=0) - 1.0) * mask      # [T, E]
-        keep = mask * (position < capacity)
-        pos_onehot = jax.nn.one_hot(position.astype(jnp.int32), capacity,
-                                    dtype=jnp.float32)          # [T, E, C]
-        dispatch = keep[:, :, None] * pos_onehot                # [T, E, C]
-        combine = dispatch * probs[:, :, None]                  # [T, E, C]
+        if self.router_top_k == 1:
+            gates = [probs]                  # top-1 gate = raw router prob
+            masks = [mask1]
+        else:
+            # second choice: argmax with the first masked out; gates
+            # renormalized over the chosen pair (GShard)
+            probs2 = probs * (1.0 - mask1)
+            mask2 = jax.nn.one_hot(jnp.argmax(probs2, axis=-1), e,
+                                   dtype=jnp.float32)
+            p1 = jnp.sum(probs * mask1, axis=-1, keepdims=True)
+            p2 = jnp.sum(probs * mask2, axis=-1, keepdims=True)
+            denom = jnp.maximum(p1 + p2, 1e-9)
+            gates = [mask1 * (p1 / denom), mask2 * (p2 / denom)]
+            masks = [mask1, mask2]
+
+        dispatch = jnp.zeros((tokens, e, capacity), jnp.float32)
+        combine = jnp.zeros((tokens, e, capacity), jnp.float32)
+        offset = jnp.zeros((e,), jnp.float32)  # slots claimed by earlier k
+        assigned = kept = 0.0
+        for mask, gate in zip(masks, gates):
+            position = (jnp.cumsum(mask, axis=0) - 1.0) * mask + offset
+            keep = mask * (position < capacity)
+            offset = offset + mask.sum(axis=0)
+            pos_onehot = jax.nn.one_hot(position.astype(jnp.int32), capacity,
+                                        dtype=jnp.float32)      # [T, E, C]
+            dispatch = dispatch + keep[:, :, None] * pos_onehot
+            combine = combine + keep[:, :, None] * pos_onehot * gate[:, :, None]
+            assigned = assigned + mask.sum()
+            kept = kept + keep.sum()
+
+        self.sow("intermediates", "overflow",
+                 1.0 - kept / jnp.maximum(assigned, 1.0))
 
         # --- expert FFN (stacked weights, expert axis sharded) -----------
         init = nn.initializers.lecun_normal()
@@ -102,6 +153,7 @@ class MoEClassifier(nn.Module):
     expert_hidden: int = 256
     depth: int = 1
     capacity_factor: float = 1.25
+    router_top_k: int = 1
     dropout_rate: float = 0.1
     partition_experts: bool = False
     dtype: jnp.dtype = jnp.float32
@@ -114,6 +166,7 @@ class MoEClassifier(nn.Module):
             y = MoELayer(num_experts=self.num_experts,
                          hidden=self.expert_hidden,
                          capacity_factor=self.capacity_factor,
+                         router_top_k=self.router_top_k,
                          partition_experts=self.partition_experts,
                          dtype=self.dtype)(x)
             x = x + y  # residual: dropped (over-capacity) tokens pass through

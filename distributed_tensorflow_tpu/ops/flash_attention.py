@@ -46,6 +46,32 @@ except Exception:  # pragma: no cover
 NEG_INF = -1e30  # matches parallel.ring_attention.NEG_INF: keeps exp()
                  # NaN-free when an entire row is masked
 _TINY = 1e-30
+_VMEM_BYTES = 128 * 2**20  # v4/v5e/v5p VMEM ≈ 128 MiB; the budget below
+                           # validates block sizes BEFORE launching Mosaic
+
+
+def _check_vmem_budget(bq: int, bk: int, d: int) -> None:
+    """Fail fast (and clearly) when the requested blocks cannot fit VMEM.
+
+    Per grid step the fwd kernel holds the (bq, bk) f32 score/prob tile,
+    q/k/v blocks (bq·d + 2·bk·d) plus the f32 accumulators (~bq·d), with
+    Pallas double-buffering the HBM-windowed operands.  An oversized
+    choice otherwise surfaces as an opaque Mosaic allocation error deep in
+    compilation.  The check is deliberately a conservative estimate (×2
+    for double buffering, f32 everywhere) against a ~128 MiB budget —
+    kernels near the line may still fail in Mosaic, but the common
+    mistake (block_q/block_k sized like sequence lengths) is caught here."""
+    tile = bq * bk * 4                       # score/prob tile, f32
+    operands = 2 * (bq * d + 2 * bk * d) * 4  # q + k/v, double-buffered
+    acc = 2 * bq * d * 4 + 2 * bq * 4        # out accumulator + m/l rows
+    need = tile + operands + acc
+    if need > _VMEM_BYTES:
+        raise ValueError(
+            f"flash attention blocks block_q={bq}, block_k={bk} with "
+            f"head_dim={d} need ≈{need / 2**20:.0f} MiB of VMEM "
+            f"(> {_VMEM_BYTES / 2**20:.0f} MiB): the (block_q × block_k) "
+            f"f32 score tile must fit alongside the q/k/v blocks — use "
+            f"smaller blocks (defaults 512/1024)")
 
 
 def _interpret_default() -> bool:
@@ -367,6 +393,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
     bq = min(block_q, lq)
     bk = min(block_k, lk)
+    if not interpret:  # the interpreter has no VMEM to budget
+        _check_vmem_budget(bq, bk, d)
     pad_q = (-lq) % bq
     pad_k = (-lk) % bk
     if pad_k:
@@ -479,6 +507,7 @@ def flash_fwd_block(q, k, v, kv_mask, *, scale, causal=False,
     b, lq, h, d = q.shape
     lk = k.shape[1]
     bq, bk = min(block_q, lq), min(block_k, lk)
+    _check_vmem_budget(bq, bk, d)
     q, pad_q = _pad_seq(q, bq)
     k, _ = _pad_seq(k, bk)
     v, pad_k = _pad_seq(v, bk)
@@ -510,6 +539,7 @@ def flash_bwd_block(q, k, v, kv_mask, do, lse, delta, *, scale, causal=False,
     b, lq, h, d = q.shape
     lk = k.shape[1]
     bq, bk = min(block_q, lq), min(block_k, lk)
+    _check_vmem_budget(bq, bk, d)
     q, pad_q = _pad_seq(q, bq)
     do, _ = _pad_seq(do, bq)
     k, _ = _pad_seq(k, bk)

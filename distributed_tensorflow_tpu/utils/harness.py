@@ -67,6 +67,8 @@ class ExperimentConfig:
                                            # 'expert' mesh axis
     num_experts: int = 8                   # MoE expert count
     aux_weight: float = 0.01               # MoE load-balance loss weight
+    router_top_k: int = 1                  # MoE routing: 1 (Switch) | 2 (GShard)
+    router_z_weight: float = 0.0           # MoE router z-loss weight
     pipeline_hidden: int = 128             # pipeline stage width
     checkpoint_dir: str | None = None      # enable TrainState checkpointing
     checkpoint_every: int = 0              # steps between checkpoints (0=end only)
@@ -102,15 +104,22 @@ class _Experiment:
 
 
 def _setup(config: ExperimentConfig) -> _Experiment:
+    if config.router_z_weight and config.expert_parallel <= 1:
+        raise ValueError(
+            "--router-z-weight is applied by the expert-parallel engine; "
+            "without --expert-parallel > 1 it would be silently ignored")
     multi = [f for f in ("seq_parallel", "tensor_parallel", "pipeline_parallel",
                          "expert_parallel")
              if getattr(config, f) > 1]
     if len(multi) > 1:
         if set(multi) == {"seq_parallel", "tensor_parallel"}:
             return _setup_composite(config)
+        if set(multi) == {"pipeline_parallel", "tensor_parallel"}:
+            return _setup_pipeline_tp(config)
         raise ValueError(
-            f"{' and '.join(multi)} cannot be combined; composable pair in "
-            f"this release: tensor_parallel × seq_parallel (dp×tp×sp)")
+            f"{' and '.join(multi)} cannot be combined; composable pairs in "
+            f"this release: tensor_parallel × seq_parallel (dp×tp×sp) and "
+            f"pipeline_parallel × tensor_parallel (dp×pp×tp)")
     if config.seq_parallel > 1:
         return _setup_seq_parallel(config)
     if config.tensor_parallel > 1:
@@ -154,6 +163,12 @@ def _resolve_model(config: ExperimentConfig, num_classes: int):
                 f"models; the model_fn owns its dtype", stacklevel=2)
         return config.model_fn()
     kw = {}
+    if config.model in ("moe", "moe_mlp"):
+        # router_top_k is a MODEL knob — it applies under any engine (a
+        # -ep 1 run still routes).  router_z_weight is an ENGINE knob that
+        # only the expert-parallel engine consumes; reject it elsewhere
+        # instead of silently ignoring it (checked in _setup)
+        kw["router_top_k"] = config.router_top_k
     if config.model in _SEQUENCE_MODELS and config.attention_impl in (
             "flash", "ring_flash"):
         # the Pallas kernel is valid without a seq axis (single-device
@@ -192,11 +207,10 @@ def _load_data(config: ExperimentConfig):
     train set and the Trainer assembles global batches from local rows.
     Eval stays unsharded — every process computes the same full-test-set
     numbers, matching the reference's single server-side eval.  User
-    ``dataset_fn`` plug-ins own their sharding (mark the returned Dataset's
-    ``process_shard`` to opt in; `data.make_dataset_fn` exposes
-    shard/n_shards/index for this)."""
-    import dataclasses as _dc
-
+    ``dataset_fn`` plug-ins own their sharding: call
+    ``Dataset.process_shard_of(process_count, process_index)`` (or
+    `data.make_dataset_fn`'s ``shard=True, process=True``) to opt in to
+    per-process global-batch assembly."""
     if config.dataset_fn is not None:
         return (config.dataset_fn(config.batch_size, type="train"),
                 config.dataset_fn(config.eval_batch, type="test"))
@@ -204,9 +218,7 @@ def _load_data(config: ExperimentConfig):
     test = loaders.load_dataset(config.dataset, split="test")
     n_proc = jax.process_count()
     if n_proc > 1:
-        train = _dc.replace(
-            train.shard(n_proc, jax.process_index(), even=True),
-            process_shard=(jax.process_index(), n_proc))
+        train = train.process_shard_of(n_proc, jax.process_index())
     return train, test
 
 
@@ -375,6 +387,45 @@ def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
                        engine=engine, global_batch=_global_batch(config, dp))
 
 
+def _setup_pipeline_tp(config: ExperimentConfig) -> _Experiment:
+    """dp×pp×tp: 3-D (data, pipe, model) mesh — GPipe/1F1B schedule manual
+    over (data, pipe), Megatron TP inside each stage as a GSPMD auto axis
+    (engines/pipeline.py).  BERT stages only: the built-in MLP stages carry
+    no Megatron annotations."""
+    from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
+    from distributed_tensorflow_tpu.models.bert import bert_pipeline_stages
+
+    mesh, dp = _split_mesh(config, config.pipeline_parallel,
+                           "pipeline_parallel×tensor_parallel",
+                           meshlib.PIPE_AXIS,
+                           (config.tensor_parallel, meshlib.MODEL_AXIS))
+    train_ds, test_ds = _load_data(config)
+    if config.model not in _SEQUENCE_MODELS or config.model_fn is not None:
+        raise ValueError(
+            f"pipeline×tensor parallelism ships TP-annotated stages for "
+            f"{'/'.join(_SEQUENCE_MODELS)} (got --model {config.model}); "
+            f"custom models pass stages=(embed, block, head) with "
+            f"with_partitioning('model', ...) annotations to PipelineEngine")
+    _require_token_data(train_ds, config, "pipeline_parallel×tensor_parallel")
+    stages = bert_pipeline_stages(
+        num_classes=train_ds.num_classes,
+        vocab_size=int(max(train_ds.x.max(), test_ds.x.max())) + 1,
+        hidden=config.pipeline_hidden,
+        max_len=train_ds.x.shape[1],
+        partition_model=True,
+        dtype=modellib.resolve_dtype(config.dtype))
+    if (_global_batch(config, dp) // dp) % config.microbatches:
+        raise ValueError(
+            f"per-data-shard batch {_global_batch(config, dp) // dp} not "
+            f"divisible by microbatches {config.microbatches}")
+    engine = PipelineEngine(microbatches=config.microbatches, mesh=mesh,
+                            learning_rate=config.learning_rate,
+                            stages=stages,
+                            schedule=config.pipeline_schedule)
+    return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
+                       engine=engine, global_batch=_global_batch(config, dp))
+
+
 def _setup_expert_parallel(config: ExperimentConfig) -> _Experiment:
     """MoE mode: 2-D (data, expert) mesh; experts shard over 'expert',
     tokens over the whole mesh (engines/expert_parallel.py)."""
@@ -394,7 +445,7 @@ def _setup_expert_parallel(config: ExperimentConfig) -> _Experiment:
         model = modellib.create_model(
             "moe", num_classes=train_ds.num_classes,
             num_experts=config.num_experts, partition_experts=True,
-            dtype=config.dtype)
+            router_top_k=config.router_top_k, dtype=config.dtype)
     else:
         raise ValueError(
             f"expert_parallel needs the MoE model (got --model "
@@ -403,7 +454,8 @@ def _setup_expert_parallel(config: ExperimentConfig) -> _Experiment:
 
     engine = ExpertParallelEngine(model, mesh=mesh,
                                   learning_rate=config.learning_rate,
-                                  aux_weight=config.aux_weight)
+                                  aux_weight=config.aux_weight,
+                                  router_z_weight=config.router_z_weight)
     # the full mesh holds token shards, so the global batch scales with every
     # device, not just the data axis
     n_total = dp * config.expert_parallel
@@ -507,6 +559,8 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
 
         if config.seq_parallel > 1 and config.tensor_parallel > 1:
             engine_name = f"composite[dp*tp*sp,{config.attention_impl}]"
+        elif config.pipeline_parallel > 1 and config.tensor_parallel > 1:
+            engine_name = f"pipeline_tp[dp*pp*tp,{config.pipeline_schedule}]"
         elif config.seq_parallel > 1:
             engine_name = f"seq_parallel[{config.attention_impl}]"
         elif config.tensor_parallel > 1:

