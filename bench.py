@@ -446,17 +446,121 @@ def bench_attention(batch: int = 4, heads: int = 8, head_dim: int = 128,
     }))
 
 
+# ---------------------------------------------------------------------------
+# --lm: GPT decoder training throughput + MFU (the transformer flagship)
+# ---------------------------------------------------------------------------
+
+def gpt_train_flops_per_token(hidden: int, layers: int, ffn: int,
+                              seq_len: int, vocab: int,
+                              causal: bool = True) -> float:
+    """Analytic matmul FLOPs for one trained token of models/gpt.py:
+    per-layer QKV+out projections (8h²) and FFN (4·h·ffn), the attention
+    score/PV einsums (4·h·L, halved causal), plus the tied LM head (2·h·V);
+    ×3 for fwd+bwd.  Embedding gathers excluded (not matmuls)."""
+    per_layer = 2.0 * hidden * (4 * hidden + 2 * ffn)
+    attn = 4.0 * hidden * seq_len * (0.5 if causal else 1.0)
+    fwd = layers * (per_layer + attn) + 2.0 * hidden * vocab
+    return 3.0 * fwd
+
+
+def bench_lm(batch: int = 8, seq_len: int = 1024, vocab: int = 32768,
+             hidden: int = 512, layers: int = 8, heads: int = 8,
+             ffn: int = 2048) -> None:
+    """Training throughput (tokens/sec/chip) + MFU of a GPT-2-small-ish
+    decoder LM in bf16, flash vs dense attention — the transformer
+    counterpart of the default CNN bench, same differenced-scan-window
+    protocol."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.engines import SyncEngine
+    from distributed_tensorflow_tpu.models import create_model
+    from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+    mesh = meshlib.create_mesh()
+    n = mesh.shape[meshlib.DATA_AXIS]
+    device_kind = jax.devices()[0].device_kind
+    peak = peak_flops(device_kind)
+    flops_tok = gpt_train_flops_per_token(hidden, layers, ffn, seq_len, vocab)
+    tokens_per_step = batch * n * seq_len
+
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, vocab, (batch * n, seq_len + 1))
+    x = tok[:, :-1].astype(np.int32)
+    y = tok[:, 1:].astype(np.int32)
+
+    rows = {}
+    for impl in ("dense", "flash"):
+        model = create_model(
+            "gpt", num_classes=vocab, hidden=hidden, layers=layers,
+            heads=heads, ffn=ffn, max_len=seq_len, dropout_rate=0.0,
+            attention_impl=impl, dtype=jnp.bfloat16)
+        eng = SyncEngine(model, mesh=mesh)
+        state = eng.init_state(jax.random.key(0), x[:n])
+        xs, ys = eng.shard_batch(x, y)
+        for _ in range(3):
+            state, m = eng.step(state, xs, ys)
+        _sync(state)
+
+        def scan_body(st, _):
+            st, _m = eng.step(st, xs, ys)
+            return st, None
+
+        short, long = 5, 25
+        runs = {k: jax.jit(lambda st, k=k: jax.lax.scan(
+            scan_body, st, None, length=k)[0]) for k in (short, long)}
+        for run in runs.values():
+            state = run(state)
+        _sync(state)
+        rates = []
+        for _ in range(REPEATS):
+            t = {}
+            for k, run in runs.items():
+                t0 = time.perf_counter()
+                state = run(state)
+                _sync(state)
+                t[k] = time.perf_counter() - t0
+            per_step = (t[long] - t[short]) / (long - short)
+            rates.append(tokens_per_step / per_step)
+        med, spread = _median_spread(rates)
+        rows[impl] = {
+            "tokens_per_sec_per_chip": round(med / n, 1),
+            "spread": round(spread, 4),
+            "mfu": (round(med * flops_tok / (n * peak), 4) if peak else None),
+        }
+        del state, eng  # free HBM before the next impl compiles
+
+    print(json.dumps({
+        "metric": "gpt_lm_sync_tokens_per_sec_per_chip",
+        "config": {"batch_per_chip": batch, "seq_len": seq_len,
+                   "vocab": vocab, "hidden": hidden, "layers": layers,
+                   "heads": heads, "ffn": ffn, "dtype": "bfloat16"},
+        "flops_per_token_analytic": int(flops_tok),
+        "device": device_kind,
+        "n_devices": n,
+        "synthetic": True,
+        **{f"{k}_{kk}": vv for k, v in rows.items() for kk, vv in v.items()},
+        "flash_vs_dense": round(
+            rows["flash"]["tokens_per_sec_per_chip"]
+            / rows["dense"]["tokens_per_sec_per_chip"], 3),
+    }))
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--stream", action="store_true",
                    help="input-pipeline bench (fresh host batches per step)")
     p.add_argument("--attention", action="store_true",
                    help="flash vs dense attention on-chip microbench")
+    p.add_argument("--lm", action="store_true",
+                   help="GPT decoder LM training throughput + MFU (bf16)")
     args = p.parse_args()
     if args.stream:
         bench_stream()
     elif args.attention:
         bench_attention()
+    elif args.lm:
+        bench_lm()
     else:
         bench_throughput()
 

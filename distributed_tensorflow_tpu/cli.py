@@ -70,12 +70,24 @@ def build_parser() -> argparse.ArgumentParser:
                    default=False, help="accepted for compatibility; no-op on TPU")
     # TPU-native additions
     p.add_argument("--model", default="mlp",
-                   help="registered model name (mlp|cnn|resnet20|bert_tiny)")
+                   help="registered model name "
+                        "(mlp|cnn|resnet20|bert_tiny|gpt|moe)")
     p.add_argument("--dataset", default="mnist",
-                   help="mnist|fashion_mnist|cifar10|synthetic")
+                   help="mnist|fashion_mnist|cifar10|synthetic|glue_synth|"
+                        "lm_synth")
     p.add_argument("-e", "--epochs", type=int, default=1,
                    help="reference hardwires 1 (SURVEY.md §2.4(6))")
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--lr-schedule", default="constant",
+                   choices=["constant", "cosine", "linear"],
+                   help="LR schedule over epochs × steps-per-epoch; combine "
+                        "with --warmup-steps for a linear ramp from 0")
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="linear LR warmup steps (0 disables)")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="microbatches accumulated per optimizer step "
+                        "(sync/allreduce engines): ~K× less activation "
+                        "memory at identical math")
     p.add_argument("--sync-every", type=int, default=10,
                    help="async engine: parameter-averaging period")
     p.add_argument("-d", "--degree", type=int, default=1,
@@ -211,6 +223,9 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         batch_size=args.batch_size,
         epochs=args.epochs,
         learning_rate=args.lr,
+        lr_schedule=args.lr_schedule,
+        warmup_steps=args.warmup_steps,
+        grad_accum=args.grad_accum,
         sync_every=args.sync_every,
         degree=args.degree,
         seed=args.seed,

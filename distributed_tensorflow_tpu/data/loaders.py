@@ -218,6 +218,65 @@ def synthetic_text_classification(
     return x, y
 
 
+def synthetic_lm(
+    n: int,
+    seq_len: int = 128,
+    vocab_size: int = 128,
+    seed: int = 0,
+    split: str = "train",
+    concentration: float = 0.1,
+):
+    """First-order Markov-chain token streams for language modeling.
+
+    Each row of the transition matrix is a Dirichlet(concentration) draw —
+    low concentration makes transitions peaked, so an LM that learns the
+    chain reaches high next-token accuracy while an untrained one sits at
+    ~1/vocab: the gap is what tests assert.  Deterministic in (seed, split);
+    the chain (like the classification prototypes above) is shared across
+    splits while the trajectories are disjoint.
+
+    Returns ``(x, y)`` with x = tokens[:, :-1] and y = tokens[:, 1:] —
+    next-token targets are materialized by the DATASET, so models never
+    shift internally and every engine's (input, label) contract is identical
+    to classification (just with (B, L)-shaped labels).
+    """
+    proto_rng = np.random.default_rng(seed)
+    trans = proto_rng.dirichlet(
+        np.full(vocab_size, concentration), size=vocab_size)
+    cdf = np.cumsum(trans, axis=1)
+    rng = np.random.default_rng((seed, 0 if split == "train" else 1))
+    seq = np.empty((n, seq_len + 1), np.int64)
+    seq[:, 0] = rng.integers(0, vocab_size, size=n)
+    for t in range(1, seq_len + 1):
+        u = rng.random(n)
+        # inverse-CDF sampling, vectorized over rows; clip guards the float
+        # edge where a row's cumsum tops out below 1.0 and a draw lands past
+        # it — unclipped that yields the out-of-range id == vocab_size
+        seq[:, t] = np.minimum(
+            (cdf[seq[:, t - 1]] < u[:, None]).sum(axis=1), vocab_size - 1)
+    seq = seq.astype(np.int32)
+    return seq[:, :-1], seq[:, 1:]
+
+
+def load_lm_dataset(
+    name: str = "lm_synth",
+    split: str = "train",
+    seq_len: int = 128,
+    vocab_size: int = 128,
+    n_train: int = 4096,
+    n_test: int = 1024,
+) -> Dataset:
+    """Language-modeling workload: (B, L) token inputs with (B, L)
+    next-token targets (``num_classes`` = vocab size, so the engines' loss —
+    which broadcasts over label dims, engines/base.py — trains it unchanged).
+    Synthetic-only, like the text loader: zero-egress environment."""
+    n = n_train if split == "train" else n_test
+    x, y = synthetic_lm(n, seq_len=seq_len, vocab_size=vocab_size,
+                        seed=sum(ord(c) for c in name) % (2**31), split=split)
+    return Dataset(x=x, y=y, num_classes=vocab_size, name=name,
+                   synthetic=True)
+
+
 def load_text_dataset(
     name: str = "glue_synth",
     split: str = "train",
@@ -249,6 +308,8 @@ def load_dataset(
     """
     if name in ("glue_synth", "text", "glue"):
         return load_text_dataset(name, split=split)
+    if name in ("lm_synth", "lm"):
+        return load_lm_dataset(name, split=split)
     if name in ("synthetic", "synth"):
         name, shape, ncls, path = "synthetic", (28, 28), 10, None
     elif name in _SHAPES:

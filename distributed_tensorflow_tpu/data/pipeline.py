@@ -46,7 +46,8 @@ def iter_batches(
             mask = np.ones(len(take), dtype=np.float32)
             pad = batch_size - len(take)
             bx = np.concatenate([bx, np.zeros((pad, *x.shape[1:]), x.dtype)])
-            by = np.concatenate([by, np.zeros(pad, y.dtype)])
+            # labels may be multi-dim (LM next-token targets are (B, L))
+            by = np.concatenate([by, np.zeros((pad, *y.shape[1:]), y.dtype)])
             mask = np.concatenate([mask, np.zeros(pad, np.float32)])
             yield bx, by, mask
             return

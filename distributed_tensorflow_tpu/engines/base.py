@@ -42,6 +42,32 @@ def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
 
 
+def cross_entropy_onehot(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Cross-entropy via the one-hot contraction instead of a label gather.
+
+    Same math as :func:`cross_entropy`; exists because XLA's SPMD
+    partitioner CHECK-crashes (spmd_partitioner_util.cc device-group check)
+    partitioning the take-along-axis GATHER over vocab-sharded logits inside
+    a partial-manual shard_map region (composite engine + Megatron-TP GPT,
+    whose tied head keeps logits vocab-sharded).  The one-hot form lowers to
+    a reduction the partitioner handles; the extra FLOPs fuse into the loss
+    reduction and are negligible next to the head matmul."""
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.sum(
+        jax.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype) * logits,
+        axis=-1)
+    return lse - picked
+
+
+def token_weights(mask: jax.Array, y: jax.Array) -> jax.Array:
+    """Per-element eval weights: the pipeline yields one validity flag per
+    ROW (B,), but LM labels are (B, L) per-token — broadcast the row mask
+    over the label's trailing dims so `correct/loss/count` count tokens for
+    LMs and examples for classifiers with one code path."""
+    mask = mask.reshape(mask.shape + (1,) * (y.ndim - mask.ndim))
+    return jnp.broadcast_to(mask, y.shape)
+
+
 def make_loss_fn(apply_fn: Callable) -> Callable:
     def loss_fn(params, x, y, rng):
         logits = apply_fn({"params": params}, x, train=True, rngs={"dropout": rng})
@@ -134,9 +160,10 @@ class Engine:
 
         def eval_step(params, x, y, mask):
             logits = logits_fn(params, x)
-            correct = ((logits.argmax(-1) == y) * mask).sum()
-            loss_sum = (cross_entropy(logits, y) * mask).sum()
-            return correct, loss_sum, mask.sum()
+            w = token_weights(mask, y)
+            correct = ((logits.argmax(-1) == y) * w).sum()
+            loss_sum = (cross_entropy(logits, y) * w).sum()
+            return correct, loss_sum, w.sum()
 
         return jax.jit(eval_step)
 
@@ -146,10 +173,11 @@ class Engine:
 
         def device_eval(params, x, y, mask):
             logits = apply_fn({"params": params}, x, train=False)
+            w = token_weights(mask, y)
             correct = coll.all_reduce_sum(
-                ((logits.argmax(-1) == y) * mask).sum(), axis)
-            loss_sum = coll.all_reduce_sum((cross_entropy(logits, y) * mask).sum(), axis)
-            count = coll.all_reduce_sum(mask.sum(), axis)
+                ((logits.argmax(-1) == y) * w).sum(), axis)
+            loss_sum = coll.all_reduce_sum((cross_entropy(logits, y) * w).sum(), axis)
+            count = coll.all_reduce_sum(w.sum(), axis)
             return correct, loss_sum, count
 
         smapped = jax.shard_map(

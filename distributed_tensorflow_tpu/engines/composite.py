@@ -33,7 +33,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_tpu.engines.base import (
-    Engine, TrainState, cross_entropy)
+    Engine, TrainState, cross_entropy, cross_entropy_onehot, token_weights)
 from distributed_tensorflow_tpu.parallel import collectives as coll
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
@@ -71,6 +71,11 @@ class CompositeEngine(Engine):
             # (identical params/math on an unsharded sequence)
             self.model = model.clone(attention_impl="dense")
         self._manual_seq = self.seq_n > 1
+        # causal LMs (models/gpt.py): (B, L) per-token labels shard over
+        # 'seq' with the inputs, and per-device logits VARY over 'seq' (no
+        # [CLS] broadcast) — the step/eval below branch on this, mirroring
+        # engines/seq_parallel.py
+        self.lm = bool(getattr(self.model, "causal_lm", False))
 
     # ------------------------------------------------------------------ init
     def init_state(self, rng, sample_x) -> TrainState:
@@ -94,8 +99,12 @@ class CompositeEngine(Engine):
         xspec = (P(self.axis, self.seq_axis) if self._manual_seq
                  else P(self.axis, *([None] * (x.ndim - 1))))
         xs = self._place(x, NamedSharding(self.mesh, xspec), process_local)
-        ys = self._place(y, NamedSharding(self.mesh, P(self.axis)),
-                         process_local)
+        # LM targets are per-token (B, L): under manual seq they shard with
+        # the inputs so each seq device scores its own token block
+        yspec = (P(self.axis, self.seq_axis)
+                 if self.lm and self._manual_seq and y.ndim >= 2
+                 else P(self.axis))
+        ys = self._place(y, NamedSharding(self.mesh, yspec), process_local)
         if mask is None:
             return xs, ys
         ms = self._place(mask, NamedSharding(self.mesh, P(self.axis)),
@@ -107,6 +116,7 @@ class CompositeEngine(Engine):
         apply_fn = self.model.apply
         tx = self.tx
         seq_axis, manual = self.seq_axis, self._manual_seq
+        lm, sp = self.lm, self.seq_n
 
         def train_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
@@ -119,25 +129,35 @@ class CompositeEngine(Engine):
                 logits = apply_fn({"params": params}, x, train=True,
                                   rngs={"dropout": rng})
                 # global-batch mean: 'data' is a GSPMD axis in both paths, so
-                # the mean is global as written; over 'seq' the loss is
-                # invariant (logits come from the [CLS] broadcast)
-                loss = cross_entropy(logits, y).mean()
+                # the mean is global as written.  Over 'seq': classification
+                # logits are invariant ([CLS] broadcast) and the loss needs
+                # no scale; LM logits VARY (each device scores its token
+                # block), so the local mean covers 1/sp of the tokens — the
+                # 1/sp scale makes the seq psum of partial cotangents the
+                # global-mean gradient (same argument as seq_parallel.py).
+                ce = cross_entropy_onehot if (manual and lm) else cross_entropy
+                loss = ce(logits, y).mean()
                 acc = (logits.argmax(-1) == y).mean()
-                return loss, acc
+                scale = sp if (manual and lm) else 1
+                return loss / scale, (loss, acc)
 
-            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params)
+            (_, (loss, acc)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
+            if manual and lm:  # per-seq-block values → report global means
+                loss = jax.lax.pmean(loss, seq_axis)
+                acc = jax.lax.pmean(acc, seq_axis)
             return state.replace(step=state.step + 1, params=params,
                                  opt_state=opt_state), \
                 {"loss": loss, "accuracy": acc}
 
         if not manual:
             return jax.jit(train_step, donate_argnums=0)
+        y_spec = P(None, seq_axis) if lm else P()
         smapped = jax.shard_map(
             train_step, mesh=self.mesh, axis_names={seq_axis},
-            in_specs=(P(), P(None, seq_axis), P()),
+            in_specs=(P(), P(None, seq_axis), y_spec),
             out_specs=(P(), P()),
         )
         return jax.jit(smapped, donate_argnums=0)
@@ -146,18 +166,31 @@ class CompositeEngine(Engine):
     def _build_eval(self):
         apply_fn = self.model.apply
         seq_axis, manual = self.seq_axis, self._manual_seq
+        lm = self.lm
+
+        if not manual:  # pure-GSPMD path: the shared masked eval
+            return self._build_eval_gspmd(
+                lambda params, x: apply_fn({"params": params}, x,
+                                           train=False))
 
         def eval_step(params, x, y, mask):
             logits = apply_fn({"params": params}, x, train=False)
-            correct = ((logits.argmax(-1) == y) * mask).sum()
-            loss_sum = (cross_entropy(logits, y) * mask).sum()
-            return correct, loss_sum, mask.sum()
+            w = token_weights(mask, y)
+            ce = cross_entropy_onehot if lm else cross_entropy
+            correct = ((logits.argmax(-1) == y) * w).sum()
+            loss_sum = (ce(logits, y) * w).sum()
+            count = w.sum()
+            if lm:  # every seq device scored its own token block
+                out = jax.lax.psum(jnp.stack([correct, loss_sum, count]),
+                                   seq_axis)
+                return out[0], out[1], out[2]
+            # classification: logits seq-invariant, sums already global
+            return correct, loss_sum, count
 
-        if not manual:
-            return jax.jit(eval_step)
+        y_spec = P(None, seq_axis) if lm else P()
         smapped = jax.shard_map(
             eval_step, mesh=self.mesh, axis_names={seq_axis},
-            in_specs=(P(), P(None, seq_axis), P(), P()),
+            in_specs=(P(), P(None, seq_axis), y_spec, P()),
             out_specs=(P(), P(), P()),
         )
         return jax.jit(smapped)

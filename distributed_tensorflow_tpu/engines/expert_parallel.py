@@ -60,10 +60,15 @@ class ExpertParallelEngine(Engine):
 
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
                  aux_weight: float = 0.01, router_z_weight: float = 0.0):
-        if mesh is None or set(mesh.axis_names) != {meshlib.DATA_AXIS,
-                                                    meshlib.EXPERT_AXIS}:
+        # (data, expert) base mesh; an optional 'model' axis composes ep×tp
+        # — each expert's FFN Megatron-split over it (models/moe.py
+        # partition_model), still one GSPMD jit
+        valid = ({meshlib.DATA_AXIS, meshlib.EXPERT_AXIS},
+                 {meshlib.DATA_AXIS, meshlib.EXPERT_AXIS, meshlib.MODEL_AXIS})
+        if mesh is None or set(mesh.axis_names) not in valid:
             raise ValueError(
-                "ExpertParallelEngine requires a ('data','expert') mesh")
+                "ExpertParallelEngine requires a ('data','expert'[,'model']) "
+                "mesh")
         self.aux_weight = aux_weight
         self.router_z_weight = router_z_weight
         super().__init__(model, optimizer, mesh, learning_rate)
@@ -128,11 +133,5 @@ class ExpertParallelEngine(Engine):
 
     def _build_eval(self):
         apply_fn = self.model.apply
-
-        def eval_step(params, x, y, mask):
-            logits = apply_fn({"params": params}, x, train=False)
-            correct = ((logits.argmax(-1) == y) * mask).sum()
-            loss_sum = (cross_entropy(logits, y) * mask).sum()
-            return correct, loss_sum, mask.sum()
-
-        return jax.jit(eval_step)
+        return self._build_eval_gspmd(
+            lambda params, x: apply_fn({"params": params}, x, train=False))

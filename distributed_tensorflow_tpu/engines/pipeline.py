@@ -285,7 +285,7 @@ class PipelineEngine(Engine):
             stage = lax.axis_index(pipe_axis)
             mb = x.shape[0] // M
             micro_x = x.reshape((M, mb) + x.shape[1:])
-            micro_y = y.reshape((M, mb))
+            micro_y = y.reshape((M, mb) + y.shape[1:])
             perm = [(i, (i + 1) % S) for i in range(S)]
 
             def loss_fn(params):
@@ -424,7 +424,7 @@ class PipelineEngine(Engine):
             micro_x = lax.pcast(
                 x.reshape((M, mb) + x.shape[1:]), pipe_axis, to="varying")
             micro_y = lax.pcast(
-                y.reshape((M, mb)), pipe_axis, to="varying")
+                y.reshape((M, mb) + y.shape[1:]), pipe_axis, to="varying")
             perm_f = [(i, (i + 1) % S) for i in range(S)]
             perm_b = [(i, (i - 1) % S) for i in range(S)]
             both = (data_axis, pipe_axis)

@@ -27,7 +27,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_tpu.engines.base import (
-    Engine, TrainState, cross_entropy)
+    Engine, TrainState, cross_entropy, token_weights)
 from distributed_tensorflow_tpu.parallel import collectives as coll
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
@@ -55,6 +55,11 @@ class SeqParallelEngine(Engine):
                 "would silently attend within local blocks only")
         super().__init__(model, optimizer, mesh, learning_rate)
         self.seq_n = mesh.shape[self.seq_axis]
+        # causal LMs (models/gpt.py) have (B, L) per-token labels that shard
+        # over (data, seq) WITH the inputs, and per-device logits that VARY
+        # over 'seq' (no [CLS] broadcast) — both loss paths below branch on
+        # this marker
+        self.lm = bool(getattr(model, "causal_lm", False))
 
     # Params are initialized OUTSIDE shard_map: the ring/broadcast collectives
     # can't trace there, so init uses a dense-attention twin (identical param
@@ -75,8 +80,11 @@ class SeqParallelEngine(Engine):
     def shard_batch(self, x, y, mask=None, process_local=False):
         xs = self._place(x, NamedSharding(
             self.mesh, P(meshlib.DATA_AXIS, meshlib.SEQ_AXIS)), process_local)
-        ys = self._place(
-            y, NamedSharding(self.mesh, P(meshlib.DATA_AXIS)), process_local)
+        # LM targets are per-token (B, L): they shard with the inputs so each
+        # seq device scores its own token block locally
+        y_spec = (P(meshlib.DATA_AXIS, meshlib.SEQ_AXIS)
+                  if self.lm and y.ndim >= 2 else P(meshlib.DATA_AXIS))
+        ys = self._place(y, NamedSharding(self.mesh, y_spec), process_local)
         if mask is None:
             return xs, ys
         ms = self._place(
@@ -88,6 +96,7 @@ class SeqParallelEngine(Engine):
         apply_fn = self.model.apply
         tx = self.tx
         data_axis, seq_axis = self.axis, self.seq_axis
+        lm = self.lm
 
         def device_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
@@ -98,39 +107,49 @@ class SeqParallelEngine(Engine):
             # offsets in every block (structured, weaker regularization)
             rng = jax.random.fold_in(rng, coll.axis_index(seq_axis))
             dp = lax.axis_size(data_axis)
+            sp = lax.axis_size(seq_axis)
 
             def scaled_loss(params):
                 logits = apply_fn({"params": params}, x, train=True,
                                   rngs={"dropout": rng})
                 loss = cross_entropy(logits, y).mean()
                 acc = (logits.argmax(-1) == y).mean()
-                # The loss is varying over 'data' (per-shard batches) and
-                # INVARIANT over 'seq' (logits come from the [CLS] broadcast,
-                # identical on every seq device).  shard_map's AD transpose
-                # psums param-cotangents over BOTH axes at the
-                # varying→invariant boundaries (incl. through the ring's
-                # ppermutes), so with the 1/dp scaling the returned grads are
-                # exactly the global-batch mean gradient — no explicit grad
-                # collectives (verified against single-device dense training
-                # in tests/test_seq_parallel.py, with SGD so scaling can't
-                # hide behind Adam's scale invariance).
-                return loss / dp, (loss, acc)
+                # Classification: the loss is varying over 'data' (per-shard
+                # batches) and INVARIANT over 'seq' (logits come from the
+                # [CLS] broadcast, identical on every seq device).
+                # shard_map's AD transpose psums param-cotangents over BOTH
+                # axes at the varying→invariant boundaries (incl. through
+                # the ring's ppermutes), so with the 1/dp scaling the
+                # returned grads are exactly the global-batch mean gradient
+                # — no explicit grad collectives (verified against
+                # single-device dense training in tests/test_seq_parallel.py,
+                # with SGD so scaling can't hide behind Adam's scale
+                # invariance).
+                #
+                # LM: per-token logits VARY over 'seq' too — each device's
+                # local mean covers 1/(dp·sp) of the global tokens, so the
+                # scale is 1/(dp·sp); the psum over both axes then sums the
+                # per-device partials into the global-mean gradient (same
+                # oracle test, tests/test_gpt.py).
+                return loss / (dp * sp if lm else dp), (loss, acc)
 
             (_, (loss, acc)), grads = jax.value_and_grad(
                 scaled_loss, has_aux=True)(state.params)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
+            axes = (data_axis, seq_axis) if lm else data_axis
             metrics = {
-                "loss": lax.pmean(loss, data_axis),
-                "accuracy": lax.pmean(acc, data_axis),
+                "loss": lax.pmean(loss, axes),
+                "accuracy": lax.pmean(acc, axes),
             }
             new_state = state.replace(
                 step=state.step + 1, params=params, opt_state=opt_state)
             return new_state, metrics
 
+        y_spec = P(data_axis, seq_axis) if lm else P(data_axis)
         smapped = jax.shard_map(
             device_step, mesh=self.mesh,
-            in_specs=(P(), P(data_axis, seq_axis), P(data_axis)),
+            in_specs=(P(), P(data_axis, seq_axis), y_spec),
             out_specs=(P(), P()),
         )
         return jax.jit(smapped, donate_argnums=0)
@@ -138,20 +157,25 @@ class SeqParallelEngine(Engine):
     def _build_eval(self):
         apply_fn = self.model.apply
         data_axis, seq_axis = self.axis, self.seq_axis
+        lm = self.lm
 
         def device_eval(params, x, y, mask):
             logits = apply_fn({"params": params}, x, train=False)
-            correct = ((logits.argmax(-1) == y) * mask).sum()
-            loss_sum = (cross_entropy(logits, y) * mask).sum()
-            count = mask.sum()
-            # logits identical across seq (invariant): only the data axis
-            # needs reducing
-            out = lax.psum(jnp.stack([correct, loss_sum, count]), data_axis)
+            w = token_weights(mask, y)
+            correct = ((logits.argmax(-1) == y) * w).sum()
+            loss_sum = (cross_entropy(logits, y) * w).sum()
+            count = w.sum()
+            # classification: logits identical across seq (invariant), only
+            # the data axis reduces.  LM: every seq device scored its own
+            # token block — reduce both.
+            axes = (data_axis, seq_axis) if lm else data_axis
+            out = lax.psum(jnp.stack([correct, loss_sum, count]), axes)
             return out[0], out[1], out[2]
 
+        y_spec = P(data_axis, seq_axis) if lm else P(data_axis)
         smapped = jax.shard_map(
             device_eval, mesh=self.mesh,
-            in_specs=(P(), P(data_axis, seq_axis), P(data_axis), P(data_axis)),
+            in_specs=(P(), P(data_axis, seq_axis), y_spec, P(data_axis)),
             out_specs=(P(), P(), P()),
         )
         return jax.jit(smapped)

@@ -16,6 +16,7 @@ MultiWorkerMirroredStrategy RING replacement, reference dist_keras.py:77-78):
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 
@@ -24,30 +25,91 @@ from distributed_tensorflow_tpu.parallel import collectives as coll
 
 
 class SyncEngine(Engine):
+    """``grad_accum`` K > 1 splits each device's batch shard into K
+    microbatches and accumulates their gradients inside one jitted step
+    before the single optimizer update — identical math to K=1 on the same
+    global batch (mean of equal-sized chunk means; parity-tested with SGD in
+    tests/test_engines.py), but peak activation memory drops ~K×.  This is
+    the standard large-batch-beyond-HBM device-side technique; the reference
+    has no counterpart (its batch lives on the host and grads stream out
+    per-batch, reference client.py:78-95)."""
+
+    def __init__(self, *args, grad_accum: int = 1, **kw):
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        super().__init__(*args, **kw)
+        self.grad_accum = grad_accum
+
     def _build_step(self):
         loss_fn = make_loss_fn(self.model.apply)
-        tx, axis = self.tx, self.axis
+        tx, axis, K = self.tx, self.axis, self.grad_accum
 
         def device_step(state: TrainState, x, y):
             rng = self._per_device_rng(state.rng, state.step)
             n = jax.lax.axis_size(axis)
 
-            def scaled_loss(params):
-                loss, acc = loss_fn(params, x, y, rng)
-                # scale so the cross-device SUM of per-device losses is the
-                # global batch mean: under shard_map's varying-axes typing,
-                # grad-of-replicated-params IS psum'd over the data axis by
-                # the AD transpose (the varying→invariant boundary).  That
-                # implicit psum is the allreduce of sync DP — the XLA
-                # equivalent of the reference's per-batch TCP round-trip of
-                # pickled grads up + weights down (reference client.py:85-90).
-                # An explicit pmean here would silently no-op (invariant
-                # input), wrecking the scale — tested against single-device
-                # training with SGD in tests/test_engines.py.
-                return loss / n, (loss, acc)
+            def scaled_loss(params, xc, yc, rng_c):
+                loss, acc = loss_fn(params, xc, yc, rng_c)
+                # scale so the cross-device AND cross-microbatch SUM of
+                # losses is the global batch mean: under shard_map's
+                # varying-axes typing, grad-of-replicated-params IS psum'd
+                # over the data axis by the AD transpose (the
+                # varying→invariant boundary).  That implicit psum is the
+                # allreduce of sync DP — the XLA equivalent of the
+                # reference's per-batch TCP round-trip of pickled grads up +
+                # weights down (reference client.py:85-90).  An explicit
+                # pmean here would silently no-op (invariant input),
+                # wrecking the scale — tested against single-device training
+                # with SGD in tests/test_engines.py.
+                return loss / (n * K), (loss, acc)
 
-            (_, (loss, acc)), grads = jax.value_and_grad(
-                scaled_loss, has_aux=True)(state.params)
+            grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
+
+            if K == 1:
+                (_, (loss, acc)), grads = grad_fn(state.params, x, y, rng)
+            else:
+                if x.shape[0] % K:
+                    raise ValueError(
+                        f"per-device batch {x.shape[0]} not divisible by "
+                        f"grad_accum {K}")
+                xm = x.reshape((K, x.shape[0] // K) + x.shape[1:])
+                ym = y.reshape((K, y.shape[0] // K) + y.shape[1:])
+                # differentiate w.r.t. a VARYING copy of the params so each
+                # microbatch's gradient stays device-local (no varying→
+                # invariant boundary inside the scan body): the implicit
+                # AD-transpose psum would otherwise all-reduce the full
+                # gradient K times per step, multiplying DP communication
+                # by K — the one explicit psum after the scan is the whole
+                # cross-device cost, same as K=1
+                params_v = jax.tree.map(
+                    lambda p: jax.lax.pcast(p, axis, to="varying"),
+                    state.params)
+
+                def micro(carry, chunk):
+                    g_acc, l_acc, a_acc, i = carry
+                    xc, yc = chunk
+                    # independent dropout per microbatch, like separate steps
+                    (_, (l, a)), g = grad_fn(params_v, xc, yc,
+                                             jax.random.fold_in(rng, i))
+                    g_acc = jax.tree.map(jnp.add, g_acc, g)
+                    return (g_acc, l_acc + l, a_acc + a, i + 1), None
+
+                # typed carry (weak Python scalars would change dtype after
+                # one addition), all varying: grads/loss/acc accumulate
+                # per-device values until the final psum
+                zeros = jax.tree.map(
+                    lambda p: jax.lax.pcast(jnp.zeros_like(p), axis,
+                                            to="varying"), state.params)
+                var0 = jax.lax.pcast(jnp.zeros((), jnp.float32), axis,
+                                     to="varying")
+                init = (zeros, var0, var0, jnp.zeros((), jnp.int32))
+                (g_local, loss, acc, _), _ = jax.lax.scan(micro, init,
+                                                          (xm, ym))
+                # the 1/(n·K) loss scale makes this sum the global-batch
+                # mean gradient
+                grads = jax.tree.map(lambda g: jax.lax.psum(g, axis), g_local)
+                loss, acc = loss / K, acc / K
+
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             metrics = coll.all_reduce_mean({"loss": loss, "accuracy": acc}, axis)

@@ -109,11 +109,5 @@ class TensorParallelEngine(Engine):
 
     def _build_eval(self):
         apply_fn = self.model.apply
-
-        def eval_step(params, x, y, mask):
-            logits = apply_fn({"params": params}, x, train=False)
-            correct = ((logits.argmax(-1) == y) * mask).sum()
-            loss_sum = (cross_entropy(logits, y) * mask).sum()
-            return correct, loss_sum, mask.sum()
-
-        return jax.jit(eval_step)
+        return self._build_eval_gspmd(
+            lambda params, x: apply_fn({"params": params}, x, train=False))

@@ -85,9 +85,17 @@ def create_model(name: str, num_classes: int = 10, **kw) -> nn.Module:
         from distributed_tensorflow_tpu.models.moe import MoEClassifier
 
         return MoEClassifier(num_classes=num_classes, **kw)
+    if name in ("gpt", "gpt_tiny"):
+        from distributed_tensorflow_tpu.models.gpt import GPTLM
+
+        # an LM's "classes" are its tokens: the harness threads the
+        # dataset's num_classes (= vocab size for data/loaders.py lm_synth)
+        # through the same parameter every classifier uses
+        kw.setdefault("vocab_size", num_classes)
+        return GPTLM(**kw)
     if name not in _REGISTRY:
         raise KeyError(f"unknown model '{name}'; known: {sorted(_REGISTRY)} "
-                       f"+ resnet20, bert_tiny, moe")
+                       f"+ resnet20, bert_tiny, moe, gpt")
     return _REGISTRY[name](num_classes=num_classes, **kw)
 
 

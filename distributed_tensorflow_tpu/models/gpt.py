@@ -1,0 +1,315 @@
+"""GPT-style decoder-only causal language model.
+
+The reference has no language models at all (SURVEY.md §2.2: its only model
+is an MLP on 28×28, reference initializer.py:14-19) — this is TPU-native new
+capability completing the model-family story: the framework's long-context
+machinery (Pallas flash attention, ring/Ulysses sequence parallelism) exists
+for exactly this workload, and a decoder LM is the model that exercises the
+causal paths end-to-end (BERT only ever runs them non-causally).
+
+Architecture: pre-LN transformer decoder (the trainable-at-depth variant),
+learned positional embeddings, weight-tied LM head (`nn.Embed.attend`) —
+tying keeps the biggest matrix single-copy in HBM and is standard for GPT-2
+class models.  Logits are (B, L, V) for next-token prediction; the engines'
+loss/eval broadcast over label dims (engines/base.py `cross_entropy`,
+`token_weights`), so the same SyncEngine/FSDP/TP machinery that trains
+classifiers trains this LM with zero engine-side special cases.
+
+Attention is pluggable exactly like BERT (models/bert.py) but always causal:
+  'dense'      — full causal attention; any mesh.
+  'flash'      — Pallas flash kernel (ops/flash_attention.py), causal=True:
+                 the kernel skips entirely-future blocks (~2× FLOPs saved)
+                 and never materializes (L, L) scores in HBM.
+  'ring'       — causal ring attention over the 'seq' mesh axis (inside
+                 shard_map; engines/seq_parallel.py).
+  'ring_flash' — ring schedule with flash local math: entirely-future
+                 blocks never even launch a kernel.
+  'ulysses'    — all-to-all head-parallel, causal.
+
+``partition_model=True`` adds the same Megatron GSPMD annotations as BERT
+(models/bert.py:28-34): QKV column-parallel, attention out + FFN-down
+row-parallel, FFN-up column-parallel, token embedding vocab-sharded.  With
+the tied head, `attend`'s contraction against the vocab-sharded embedding
+makes the logits vocab-sharded too — XLA keeps the (B, L, V) tensor
+distributed through the softmax-cross-entropy, never gathering V onto one
+device (the Megatron vocab-parallel-loss layout, for free from GSPMD).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+from distributed_tensorflow_tpu.parallel.ring_attention import (
+    dense_attention, ring_attention, ring_flash_attention, ulysses_attention)
+
+
+def _part(init, spec, enabled: bool):
+    """Megatron annotation, applied only when TP-partitioned (mirrors
+    models/bert.py:48-52: unannotated modules keep plain initializers so
+    non-GSPMD engines see ordinary unboxed params)."""
+    return nn.with_partitioning(init, spec) if enabled else init
+
+
+class CausalSelfAttention(nn.Module):
+    """Multi-head causal self-attention with pluggable block math."""
+
+    hidden: int = 128
+    heads: int = 4
+    attention_impl: str = "dense"
+    seq_axis: str = "seq"
+    partition_model: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        head_dim = self.hidden // self.heads
+        tp = self.partition_model
+
+        # column-parallel QKV (packed output dim sharded over 'model');
+        # plain Dense for the same partial-manual-shard_map reason as BERT
+        # (models/bert.py:73-76)
+        def proj(name):
+            h = nn.Dense(
+                self.heads * head_dim, dtype=self.dtype, name=name,
+                kernel_init=_part(nn.initializers.lecun_normal(),
+                                  (None, meshlib.MODEL_AXIS), tp),
+                bias_init=_part(nn.initializers.zeros_init(),
+                                (meshlib.MODEL_AXIS,), tp))(x)
+            return h.reshape(h.shape[:-1] + (self.heads, head_dim))
+
+        q, k, v = proj("query"), proj("key"), proj("value")
+        if self.attention_impl == "ring":
+            out = ring_attention(q, k, v, axis=self.seq_axis, causal=True)
+        elif self.attention_impl == "ring_flash":
+            out = ring_flash_attention(q, k, v, axis=self.seq_axis,
+                                       causal=True)
+        elif self.attention_impl == "ulysses":
+            out = ulysses_attention(q, k, v, axis=self.seq_axis, causal=True)
+        elif self.attention_impl == "flash":
+            from distributed_tensorflow_tpu.ops import flash_attention
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            out = dense_attention(q, k, v, causal=True)
+        out = out.reshape(out.shape[:-2] + (self.heads * head_dim,))
+        # row-parallel output projection — the pair's single all-reduce
+        return nn.Dense(
+            self.hidden, dtype=self.dtype, name="out",
+            kernel_init=_part(nn.initializers.lecun_normal(),
+                              (meshlib.MODEL_AXIS, None), tp))(out)
+
+
+class GPTBlock(nn.Module):
+    """Pre-LN decoder block: x + attn(LN(x)); x + mlp(LN(x))."""
+
+    hidden: int = 128
+    heads: int = 4
+    ffn: int = 512
+    dropout_rate: float = 0.1
+    attention_impl: str = "dense"
+    seq_axis: str = "seq"
+    partition_model: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        tp = self.partition_model
+        y = CausalSelfAttention(self.hidden, self.heads, self.attention_impl,
+                                self.seq_axis, tp, self.dtype)(
+                                    nn.LayerNorm(dtype=self.dtype)(x))
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        x = x + y
+        # Megatron FFN: column-parallel up, row-parallel down
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(
+            self.ffn, dtype=self.dtype,
+            kernel_init=_part(nn.initializers.lecun_normal(),
+                              (None, meshlib.MODEL_AXIS), tp),
+            bias_init=_part(nn.initializers.zeros_init(),
+                            (meshlib.MODEL_AXIS,), tp))(y)
+        y = nn.gelu(y)
+        y = nn.Dense(
+            self.hidden, dtype=self.dtype,
+            kernel_init=_part(nn.initializers.lecun_normal(),
+                              (meshlib.MODEL_AXIS, None), tp))(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return x + y
+
+
+class GPTLM(nn.Module):
+    """Decoder-only causal LM: token ids (B, L) → next-token logits (B, L, V).
+
+    ``causal_lm = True`` is the marker the harness/engines read to route
+    LM-shaped labels ((B, L) targets sharded over data AND seq axes,
+    engines/seq_parallel.py) — the model itself never shifts anything; the
+    dataset supplies (inputs, next-token targets) pairs (data/loaders.py
+    ``lm_synth``).
+    """
+
+    vocab_size: int = 256
+    hidden: int = 128
+    layers: int = 2
+    heads: int = 4
+    ffn: int = 512
+    max_len: int = 512
+    dropout_rate: float = 0.1
+    attention_impl: str = "dense"
+    seq_axis: str = "seq"
+    partition_model: bool = False
+    tie_embeddings: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    causal_lm = True  # read by engines/harness to select the LM data layout
+
+    @nn.compact
+    def __call__(self, token_ids, train: bool = False):
+        seq_parallel = self.attention_impl in ("ring", "ring_flash",
+                                               "ulysses")
+        lq = token_ids.shape[1]
+        global_len = lq * (coll.axis_size(self.seq_axis) if seq_parallel
+                           else 1)
+        if global_len > self.max_len:
+            raise ValueError(
+                f"sequence length {global_len} exceeds max_len="
+                f"{self.max_len}; raise max_len or shorten the input")
+        if seq_parallel:
+            # this device's token block starts at global position idx×lq
+            offset = coll.axis_index(self.seq_axis) * lq
+            pos = offset + jnp.arange(lq)[None, :]
+        else:
+            pos = jnp.arange(lq)[None, :]
+
+        embed = nn.Embed(
+            self.vocab_size, self.hidden, dtype=self.dtype,
+            name="token_embed",
+            embedding_init=_part(nn.linear.default_embed_init,
+                                 (meshlib.MODEL_AXIS, None),
+                                 self.partition_model))
+        x = embed(token_ids)
+        x = x + nn.Embed(self.max_len, self.hidden, dtype=self.dtype,
+                         name="pos_embed")(pos)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for _ in range(self.layers):
+            x = GPTBlock(self.hidden, self.heads, self.ffn,
+                         self.dropout_rate, self.attention_impl,
+                         self.seq_axis, self.partition_model,
+                         self.dtype)(x, train)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.tie_embeddings:
+            # tied head: contraction against the (possibly vocab-sharded)
+            # embedding — under TP the logits stay vocab-sharded through the
+            # loss (Megatron vocab-parallel layout)
+            logits = embed.attend(x)
+        else:
+            logits = nn.Dense(
+                self.vocab_size, dtype=self.dtype, name="lm_head",
+                kernel_init=_part(nn.initializers.lecun_normal(),
+                                  (None, meshlib.MODEL_AXIS),
+                                  self.partition_model))(x)
+        return logits.astype(jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# Pipeline stages (engines/pipeline.py `stages=` plug-in): embed → S
+# identical GPTBlock stages → final-LN + untied LM head.  The head is untied
+# by construction — the pipeline stacks stage params over 'pipe', so the
+# embedding (stage 0's params) is not addressable from the head stage;
+# weight tying across pipeline stages would need a cross-stage ppermute of
+# the embedding every step, which costs more than the untied head it saves.
+# Dropout-free, like the BERT stages (models/bert.py:233-240): the schedule
+# re-applies stages every tick, so rng-consuming ops would draw
+# inconsistent masks.
+# --------------------------------------------------------------------------
+
+
+class GPTPipeEmbed(nn.Module):
+    """Input stage: token + position embeddings."""
+
+    vocab_size: int = 256
+    hidden: int = 128
+    max_len: int = 512
+    partition_model: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, token_ids):
+        if token_ids.shape[1] > self.max_len:
+            raise ValueError(
+                f"sequence length {token_ids.shape[1]} exceeds "
+                f"max_len={self.max_len}")
+        pos = jnp.arange(token_ids.shape[1])[None, :]
+        x = nn.Embed(
+            self.vocab_size, self.hidden, dtype=self.dtype,
+            embedding_init=_part(nn.linear.default_embed_init,
+                                 (meshlib.MODEL_AXIS, None),
+                                 self.partition_model))(token_ids)
+        return x + nn.Embed(self.max_len, self.hidden,
+                            dtype=self.dtype)(pos)
+
+
+class GPTPipeBlock(nn.Module):
+    """One pipeline stage: ``layers_per_stage`` pre-LN decoder blocks."""
+
+    hidden: int = 128
+    heads: int = 4
+    ffn: int = 512
+    layers_per_stage: int = 1
+    partition_model: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for _ in range(self.layers_per_stage):
+            x = GPTBlock(self.hidden, self.heads, self.ffn,
+                         dropout_rate=0.0, attention_impl="dense",
+                         partition_model=self.partition_model,
+                         dtype=self.dtype)(x)
+        return x
+
+
+class GPTPipeHead(nn.Module):
+    """Output stage: final LN → untied LM head (see module comment)."""
+
+    vocab_size: int = 256
+    hidden: int = 128
+    partition_model: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        logits = nn.Dense(
+            self.vocab_size, dtype=self.dtype,
+            kernel_init=_part(nn.initializers.lecun_normal(),
+                              (None, meshlib.MODEL_AXIS),
+                              self.partition_model))(x)
+        return logits.astype(jnp.float32)
+
+
+def gpt_pipeline_stages(
+    vocab_size: int = 256,
+    hidden: int = 128,
+    heads: int = 4,
+    ffn: int = 512,
+    max_len: int = 512,
+    layers_per_stage: int = 1,
+    partition_model: bool = False,
+    dtype: jnp.dtype = jnp.float32,
+    num_classes: int | None = None,  # alias for vocab_size (harness passes it)
+):
+    """(embed, block, head) for ``PipelineEngine(stages=...)``: a GPT decoder
+    of depth ``pipe_axis_size × layers_per_stage``.  ``partition_model=True``
+    adds Megatron TP annotations for pp×tp."""
+    if num_classes is not None:
+        vocab_size = num_classes
+    return (
+        GPTPipeEmbed(vocab_size=vocab_size, hidden=hidden, max_len=max_len,
+                     partition_model=partition_model, dtype=dtype),
+        GPTPipeBlock(hidden=hidden, heads=heads, ffn=ffn,
+                     layers_per_stage=layers_per_stage,
+                     partition_model=partition_model, dtype=dtype),
+        GPTPipeHead(vocab_size=vocab_size, hidden=hidden,
+                    partition_model=partition_model, dtype=dtype),
+    )

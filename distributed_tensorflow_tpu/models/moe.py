@@ -58,6 +58,10 @@ class MoELayer(nn.Module):
     capacity_factor: float = 1.25
     router_top_k: int = 1
     partition_experts: bool = False
+    partition_model: bool = False   # ep×tp: Megatron-split each expert's FFN
+                                    # over the 'model' axis on top of the
+                                    # expert sharding (GShard's 2-D expert
+                                    # layout); requires partition_experts
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -124,11 +128,26 @@ class MoELayer(nn.Module):
                  1.0 - kept / jnp.maximum(assigned, 1.0))
 
         # --- expert FFN (stacked weights, expert axis sharded) -----------
-        init = nn.initializers.lecun_normal()
+        if self.partition_model and not self.partition_experts:
+            raise ValueError(
+                "partition_model on MoELayer means ep×tp (Megatron split "
+                "inside each expert) and requires partition_experts=True")
+        init1 = init2 = nn.initializers.lecun_normal()
         if self.partition_experts:
-            init = nn.with_partitioning(init, (meshlib.EXPERT_AXIS, None, None))
-        w1 = self.param("w1", init, (e, d, self.hidden), jnp.float32)
-        w2 = self.param("w2", init, (e, self.hidden, d), jnp.float32)
+            # ep×tp: within each expert, w1 is column-parallel (hidden dim
+            # sharded over 'model') and w2 row-parallel (contraction dim
+            # sharded) — the [E/ep, C, hidden] activation stays model-sharded
+            # between them and GSPMD emits one psum per expert FFN pair,
+            # exactly the Megatron layout lifted over the stacked expert dim
+            tp_axis = meshlib.MODEL_AXIS if self.partition_model else None
+            init1 = nn.with_partitioning(
+                nn.initializers.lecun_normal(),
+                (meshlib.EXPERT_AXIS, None, tp_axis))
+            init2 = nn.with_partitioning(
+                nn.initializers.lecun_normal(),
+                (meshlib.EXPERT_AXIS, tp_axis, None))
+        w1 = self.param("w1", init1, (e, d, self.hidden), jnp.float32)
+        w2 = self.param("w2", init2, (e, self.hidden, d), jnp.float32)
 
         expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(self.dtype),
                                x.astype(self.dtype))
@@ -156,6 +175,7 @@ class MoEClassifier(nn.Module):
     router_top_k: int = 1
     dropout_rate: float = 0.1
     partition_experts: bool = False
+    partition_model: bool = False   # ep×tp (see MoELayer)
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -168,6 +188,7 @@ class MoEClassifier(nn.Module):
                          capacity_factor=self.capacity_factor,
                          router_top_k=self.router_top_k,
                          partition_experts=self.partition_experts,
+                         partition_model=self.partition_model,
                          dtype=self.dtype)(x)
             x = x + y  # residual: dropped (over-capacity) tokens pass through
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)

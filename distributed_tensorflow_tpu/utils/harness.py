@@ -42,6 +42,21 @@ class ExperimentConfig:
     per_worker_batch: bool = True   # interpret batch_size per device like -b
     epochs: int = 1                 # reference fixes 1 (SURVEY.md §2.4(6))
     learning_rate: float = 1e-3
+    lr_schedule: str = "constant"   # constant | cosine | linear (each with
+                                    # optional linear warmup); horizon =
+                                    # epochs × steps-per-epoch
+    warmup_steps: int = 0           # linear LR warmup from 0 over this many
+                                    # steps (0 disables)
+    schedule_horizon_steps: int | None = None  # decay horizon override for
+                                    # --lr-schedule; default = epochs ×
+                                    # steps-per-epoch (steps_to_accuracy sets
+                                    # it to max_steps: its loop runs far past
+                                    # one epoch, and a horizon computed from
+                                    # config.epochs would decay LR to 0 with
+                                    # thousands of steps still to train)
+    grad_accum: int = 1             # microbatches accumulated per optimizer
+                                    # step (sync/allreduce engines): ~K× less
+                                    # activation memory at identical math
     sync_every: int = 10            # async engine's averaging period
     degree: int = 1                 # gossip neighbor degree (the -d flag)
     seed: int = 0
@@ -116,10 +131,13 @@ def _setup(config: ExperimentConfig) -> _Experiment:
             return _setup_composite(config)
         if set(multi) == {"pipeline_parallel", "tensor_parallel"}:
             return _setup_pipeline_tp(config)
+        if set(multi) == {"expert_parallel", "tensor_parallel"}:
+            return _setup_expert_tp(config)
         raise ValueError(
             f"{' and '.join(multi)} cannot be combined; composable pairs in "
-            f"this release: tensor_parallel × seq_parallel (dp×tp×sp) and "
-            f"pipeline_parallel × tensor_parallel (dp×pp×tp)")
+            f"this release: tensor_parallel × seq_parallel (dp×tp×sp), "
+            f"pipeline_parallel × tensor_parallel (dp×pp×tp), and "
+            f"expert_parallel × tensor_parallel (dp×ep×tp)")
     if config.seq_parallel > 1:
         return _setup_seq_parallel(config)
     if config.tensor_parallel > 1:
@@ -132,6 +150,9 @@ def _setup(config: ExperimentConfig) -> _Experiment:
     n = mesh.shape[meshlib.DATA_AXIS]
 
     train_ds, test_ds = _load_data(config)
+    if config.model in _LM_MODELS and config.model_fn is None:
+        # fail with the dataset hint, not a cryptic Embed trace error
+        _require_token_data(train_ds, config, f"engine '{config.engine}'")
     model = _resolve_model(config, train_ds.num_classes)
 
     # reference -b is the PER-WORKER batch (reference client.py:64 feeds each
@@ -139,14 +160,80 @@ def _setup(config: ExperimentConfig) -> _Experiment:
     # aggregate examples-per-round
     global_batch = _global_batch(config, n)
 
-    engine_kw: dict[str, Any] = dict(mesh=mesh, learning_rate=config.learning_rate)
+    engine_kw: dict[str, Any] = dict(
+        mesh=mesh, learning_rate=config.learning_rate,
+        optimizer=_make_optimizer(config, train_ds, global_batch))
     if config.engine == "async":
         engine_kw["sync_every"] = config.sync_every
     elif config.engine == "gossip":
         engine_kw["degree"] = config.degree
+    if config.grad_accum > 1:
+        if config.engine not in ("sync", "allreduce"):
+            raise ValueError(
+                f"grad_accum is implemented by the sync/allreduce engines "
+                f"(got engine='{config.engine}')")
+        if (global_batch // n) % config.grad_accum:
+            raise ValueError(
+                f"per-device batch {global_batch // n} not divisible by "
+                f"grad_accum {config.grad_accum}")
+    if config.engine in ("sync", "allreduce"):
+        engine_kw["grad_accum"] = config.grad_accum
     engine = create_engine(config.engine, model, **engine_kw)
     return _Experiment(mesh=mesh, n=n, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=global_batch)
+
+
+def make_lr_schedule(config: ExperimentConfig, total_steps: int):
+    """Learning-rate schedule from --lr-schedule/--warmup-steps, or None for
+    the default (constant, no warmup).  The decay horizon is the full run:
+    ``total_steps`` = epochs × steps-per-epoch.  No reference counterpart
+    (the reference's Adam runs at its constructor default forever, reference
+    server.py:52-55) — schedules are table stakes for the transformer-scale
+    models this framework adds."""
+    import optax
+
+    lr, warm = config.learning_rate, max(config.warmup_steps, 0)
+    if config.lr_schedule not in ("constant", "cosine", "linear"):
+        raise ValueError(
+            f"unknown lr_schedule '{config.lr_schedule}'; "
+            f"known: constant, cosine, linear")
+    if config.lr_schedule == "constant" and warm == 0:
+        return None
+    total = max(total_steps, warm + 1)
+    decay = max(total - warm, 1)
+    if config.lr_schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0 if warm else lr, peak_value=lr,
+            warmup_steps=warm, decay_steps=total)
+    if config.lr_schedule == "linear":
+        main = optax.linear_schedule(lr, 0.0, decay)
+    else:  # constant after warmup
+        main = optax.constant_schedule(lr)
+    if warm == 0:
+        return main
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, lr, warm), main], [warm])
+
+
+def _make_optimizer(config: ExperimentConfig, train_ds,
+                    global_batch: int):
+    """Adam over the run's LR schedule, or None → the engine's stock
+    adam(learning_rate).
+
+    The horizon counts GLOBAL steps: a process-sharded dataset (multi-host,
+    Dataset.process_shard_of) holds 1/P of the examples but every process
+    still takes the same global-batch steps over the full set — scaling by
+    P keeps the decay reaching 0 at the run's true end, not P× early."""
+    import optax
+
+    if config.schedule_horizon_steps is not None:
+        total = config.schedule_horizon_steps
+    else:
+        shard = getattr(train_ds, "process_shard", None)
+        n_global = len(train_ds) * (shard[1] if shard else 1)
+        total = config.epochs * max(n_global // max(global_batch, 1), 1)
+    sched = make_lr_schedule(config, total)
+    return None if sched is None else optax.adam(sched)
 
 
 def _resolve_model(config: ExperimentConfig, num_classes: int):
@@ -237,6 +324,10 @@ def _split_mesh(config: ExperimentConfig, factor: int, factor_name: str,
         raise ValueError(
             f"{factor_name} supports sync semantics only, got "
             f"engine='{config.engine}'")
+    if config.grad_accum > 1:
+        raise ValueError(
+            "grad_accum is implemented by the sync/allreduce data-parallel "
+            "engines; it does not compose with model-parallel modes yet")
     factors = [(factor, second_axis), *more]
     total = config.n_devices or len(_jax.devices())
     prod = 1
@@ -251,7 +342,8 @@ def _split_mesh(config: ExperimentConfig, factor: int, factor_name: str,
     return mesh, dp
 
 
-_SEQUENCE_MODELS = ("bert_tiny", "bert")
+_SEQUENCE_MODELS = ("bert_tiny", "bert", "gpt", "gpt_tiny")
+_LM_MODELS = ("gpt", "gpt_tiny")  # causal LMs: (B, L) next-token targets
 
 
 def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
@@ -272,8 +364,10 @@ def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
     model = _sequence_model(config, train_ds, "seq_parallel",
                             attention_impl=config.attention_impl)
 
-    engine = SeqParallelEngine(model, mesh=mesh,
-                               learning_rate=config.learning_rate)
+    engine = SeqParallelEngine(
+        model, mesh=mesh, learning_rate=config.learning_rate,
+        optimizer=_make_optimizer(config, train_ds,
+                                  _global_batch(config, dp)))
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
 
@@ -294,18 +388,26 @@ def _setup_tensor_parallel(config: ExperimentConfig) -> _Experiment:
         model = _sequence_model(config, train_ds, "tensor_parallel",
                                 partition_model=True, attention_impl="dense")
 
-    engine = TensorParallelEngine(model, mesh=mesh,
-                                  learning_rate=config.learning_rate)
+    engine = TensorParallelEngine(
+        model, mesh=mesh, learning_rate=config.learning_rate,
+        optimizer=_make_optimizer(config, train_ds,
+                                  _global_batch(config, dp)))
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
 
 
 def _require_token_data(train_ds, config: ExperimentConfig, mode: str) -> None:
     if not np.issubdtype(train_ds.x.dtype, np.integer):
+        hint = ("lm_synth" if config.model in _LM_MODELS else "glue_synth")
         raise ValueError(
             f"{mode} with a sequence model needs a token dataset (integer "
             f"ids), got --dataset {config.dataset} with dtype "
-            f"{train_ds.x.dtype}; use --dataset glue_synth")
+            f"{train_ds.x.dtype}; use --dataset {hint}")
+    if config.model in _LM_MODELS and train_ds.y.ndim < 2:
+        raise ValueError(
+            f"--model {config.model} is a causal LM and needs per-token "
+            f"(B, L) targets, got labels of shape {train_ds.y.shape} from "
+            f"--dataset {config.dataset}; use --dataset lm_synth")
 
 
 def _sequence_model(config: ExperimentConfig, train_ds, mode: str, **kw):
@@ -324,6 +426,34 @@ def _sequence_model(config: ExperimentConfig, train_ds, mode: str, **kw):
         f"--model {config.model}; pass model_fn for a custom model")
 
 
+def _pipeline_stages(config: ExperimentConfig, train_ds, test_ds, mode: str,
+                     partition_model: bool = False):
+    """(embed, block, head) for the pipeline setups, by model family:
+    BERT encoder (models/bert.py) or GPT decoder LM (models/gpt.py)."""
+    _require_token_data(train_ds, config, mode)
+    dtype = modellib.resolve_dtype(config.dtype)
+    if config.model in _LM_MODELS:
+        from distributed_tensorflow_tpu.models.gpt import gpt_pipeline_stages
+
+        return gpt_pipeline_stages(
+            vocab_size=train_ds.num_classes,
+            hidden=config.pipeline_hidden,
+            max_len=train_ds.x.shape[1],
+            partition_model=partition_model,
+            dtype=dtype)
+    from distributed_tensorflow_tpu.models.bert import bert_pipeline_stages
+
+    # vocab must cover BOTH splits: nn.Embed silently clamps out-of-range
+    # ids, which would skew eval on unseen test tokens
+    return bert_pipeline_stages(
+        num_classes=train_ds.num_classes,
+        vocab_size=int(max(train_ds.x.max(), test_ds.x.max())) + 1,
+        hidden=config.pipeline_hidden,
+        max_len=train_ds.x.shape[1],
+        partition_model=partition_model,
+        dtype=dtype)
+
+
 def _setup_composite(config: ExperimentConfig) -> _Experiment:
     """dp×tp×sp composition: 3-D (data, model, seq) mesh, GSPMD tensor
     parallelism + manual-seq ring/Ulysses attention (engines/composite.py)."""
@@ -336,8 +466,10 @@ def _setup_composite(config: ExperimentConfig) -> _Experiment:
     model = _sequence_model(config, train_ds, "tensor_parallel×seq_parallel",
                             partition_model=True,
                             attention_impl=config.attention_impl)
-    engine = CompositeEngine(model, mesh=mesh,
-                             learning_rate=config.learning_rate)
+    engine = CompositeEngine(
+        model, mesh=mesh, learning_rate=config.learning_rate,
+        optimizer=_make_optimizer(config, train_ds,
+                                  _global_batch(config, dp)))
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
 
@@ -354,17 +486,8 @@ def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
     train_ds, test_ds = _load_data(config)
     stages = None
     if config.model in _SEQUENCE_MODELS and config.model_fn is None:
-        from distributed_tensorflow_tpu.models.bert import bert_pipeline_stages
-
-        _require_token_data(train_ds, config, "pipeline_parallel")
-        # vocab must cover BOTH splits: nn.Embed silently clamps
-        # out-of-range ids, which would skew eval on unseen test tokens
-        stages = bert_pipeline_stages(
-            num_classes=train_ds.num_classes,
-            vocab_size=int(max(train_ds.x.max(), test_ds.x.max())) + 1,
-            hidden=config.pipeline_hidden,
-            max_len=train_ds.x.shape[1],
-            dtype=modellib.resolve_dtype(config.dtype))
+        stages = _pipeline_stages(config, train_ds, test_ds,
+                                  "pipeline_parallel")
     elif config.model_fn is not None or config.model not in (
             "mlp", "mnist_mlp", "pipeline_mlp"):
         raise ValueError(
@@ -380,6 +503,9 @@ def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
                             hidden=config.pipeline_hidden,
                             microbatches=config.microbatches, mesh=mesh,
                             learning_rate=config.learning_rate,
+                            optimizer=_make_optimizer(
+                                config, train_ds,
+                                _global_batch(config, dp)),
                             dtype=modellib.resolve_dtype(config.dtype),
                             stages=stages,
                             schedule=config.pipeline_schedule)
@@ -390,10 +516,9 @@ def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
 def _setup_pipeline_tp(config: ExperimentConfig) -> _Experiment:
     """dp×pp×tp: 3-D (data, pipe, model) mesh — GPipe/1F1B schedule manual
     over (data, pipe), Megatron TP inside each stage as a GSPMD auto axis
-    (engines/pipeline.py).  BERT stages only: the built-in MLP stages carry
-    no Megatron annotations."""
+    (engines/pipeline.py).  Sequence-model stages only (BERT encoder or GPT
+    decoder): the built-in MLP stages carry no Megatron annotations."""
     from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
-    from distributed_tensorflow_tpu.models.bert import bert_pipeline_stages
 
     mesh, dp = _split_mesh(config, config.pipeline_parallel,
                            "pipeline_parallel×tensor_parallel",
@@ -406,34 +531,38 @@ def _setup_pipeline_tp(config: ExperimentConfig) -> _Experiment:
             f"{'/'.join(_SEQUENCE_MODELS)} (got --model {config.model}); "
             f"custom models pass stages=(embed, block, head) with "
             f"with_partitioning('model', ...) annotations to PipelineEngine")
-    _require_token_data(train_ds, config, "pipeline_parallel×tensor_parallel")
-    stages = bert_pipeline_stages(
-        num_classes=train_ds.num_classes,
-        vocab_size=int(max(train_ds.x.max(), test_ds.x.max())) + 1,
-        hidden=config.pipeline_hidden,
-        max_len=train_ds.x.shape[1],
-        partition_model=True,
-        dtype=modellib.resolve_dtype(config.dtype))
+    stages = _pipeline_stages(config, train_ds, test_ds,
+                               "pipeline_parallel×tensor_parallel",
+                               partition_model=True)
     if (_global_batch(config, dp) // dp) % config.microbatches:
         raise ValueError(
             f"per-data-shard batch {_global_batch(config, dp) // dp} not "
             f"divisible by microbatches {config.microbatches}")
     engine = PipelineEngine(microbatches=config.microbatches, mesh=mesh,
                             learning_rate=config.learning_rate,
+                            optimizer=_make_optimizer(
+                                config, train_ds,
+                                _global_batch(config, dp)),
                             stages=stages,
                             schedule=config.pipeline_schedule)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
 
 
-def _setup_expert_parallel(config: ExperimentConfig) -> _Experiment:
-    """MoE mode: 2-D (data, expert) mesh; experts shard over 'expert',
-    tokens over the whole mesh (engines/expert_parallel.py)."""
+def _setup_expert_parallel(config: ExperimentConfig,
+                           tp: int = 1) -> _Experiment:
+    """MoE mode: (data, expert) mesh, experts sharded over 'expert', tokens
+    over the data×expert plane (engines/expert_parallel.py).  ``tp > 1``
+    adds a 'model' axis — dp×ep×tp: each expert's FFN is also
+    Megatron-split (models/moe.py partition_model), still one GSPMD jit."""
     from distributed_tensorflow_tpu.engines.expert_parallel import (
         ExpertParallelEngine)
 
-    mesh, dp = _split_mesh(config, config.expert_parallel, "expert_parallel",
-                           meshlib.EXPERT_AXIS)
+    mode = ("expert_parallel×tensor_parallel" if tp > 1
+            else "expert_parallel")
+    extra = [(tp, meshlib.MODEL_AXIS)] if tp > 1 else []
+    mesh, dp = _split_mesh(config, config.expert_parallel, mode,
+                           meshlib.EXPERT_AXIS, *extra)
     train_ds, test_ds = _load_data(config)
     if config.model_fn is not None:
         model = config.model_fn()
@@ -445,23 +574,31 @@ def _setup_expert_parallel(config: ExperimentConfig) -> _Experiment:
         model = modellib.create_model(
             "moe", num_classes=train_ds.num_classes,
             num_experts=config.num_experts, partition_experts=True,
-            router_top_k=config.router_top_k, dtype=config.dtype)
+            partition_model=tp > 1, router_top_k=config.router_top_k,
+            dtype=config.dtype)
     else:
         raise ValueError(
-            f"expert_parallel needs the MoE model (got --model "
-            f"{config.model}); pass model_fn for a custom MoE with "
-            f"with_partitioning('expert', ...) annotations")
+            f"{mode} needs the MoE model (got --model {config.model}); "
+            f"custom MoEs pass model_fn with with_partitioning('expert' "
+            f"{'+ ''model'' ' if tp > 1 else ''}...) annotations")
 
-    engine = ExpertParallelEngine(model, mesh=mesh,
-                                  learning_rate=config.learning_rate,
-                                  aux_weight=config.aux_weight,
-                                  router_z_weight=config.router_z_weight)
-    # the full mesh holds token shards, so the global batch scales with every
-    # device, not just the data axis
-    n_total = dp * config.expert_parallel
+    # tokens shard over (data, expert); a model axis replicates them, so the
+    # global batch scales with the token-shard count only
+    n_token_shards = dp * config.expert_parallel
+    engine = ExpertParallelEngine(
+        model, mesh=mesh, learning_rate=config.learning_rate,
+        optimizer=_make_optimizer(config, train_ds,
+                                  _global_batch(config, n_token_shards)),
+        aux_weight=config.aux_weight,
+        router_z_weight=config.router_z_weight)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine,
-                       global_batch=_global_batch(config, n_total))
+                       global_batch=_global_batch(config, n_token_shards))
+
+
+def _setup_expert_tp(config: ExperimentConfig) -> _Experiment:
+    """dp×ep×tp — see _setup_expert_parallel(tp=...)."""
+    return _setup_expert_parallel(config, tp=config.tensor_parallel)
 
 
 def run(config: ExperimentConfig) -> dict[str, Any]:
@@ -561,6 +698,8 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
             engine_name = f"composite[dp*tp*sp,{config.attention_impl}]"
         elif config.pipeline_parallel > 1 and config.tensor_parallel > 1:
             engine_name = f"pipeline_tp[dp*pp*tp,{config.pipeline_schedule}]"
+        elif config.expert_parallel > 1 and config.tensor_parallel > 1:
+            engine_name = "expert_tp[dp*ep*tp]"
         elif config.seq_parallel > 1:
             engine_name = f"seq_parallel[{config.attention_impl}]"
         elif config.tensor_parallel > 1:
@@ -625,6 +764,12 @@ def steps_to_accuracy(
 
     from distributed_tensorflow_tpu.engines.allreduce import Trainer
 
+    if config.schedule_horizon_steps is None:
+        # this loop runs up to max_steps, far past config.epochs — an
+        # epochs-derived LR horizon would decay to 0 almost immediately and
+        # the target would silently never be reached
+        config = dataclasses.replace(config,
+                                     schedule_horizon_steps=max_steps)
     ex = _setup(config)
     trainer = Trainer(None, engine=ex.engine, seed=config.seed)
     steps_per_epoch = max(len(ex.train_ds) // ex.global_batch, 1)

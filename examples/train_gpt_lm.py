@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Causal-LM pretraining: GPT decoder on the synthetic Markov-chain corpus.
+
+The decoder family composes with every parallel mode; this example shows the
+two most useful single-knob renderings — plain DP with the Pallas causal
+flash kernel, and long-context ring-attention sequence parallelism (pass
+``--seq-parallel 4``).  No reference counterpart (SURVEY.md §2.2: no
+language models anywhere).
+
+  JAX_PLATFORM_NAME=cpu JAX_PLATFORMS="" \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python examples/train_gpt_lm.py [--seq-parallel 4]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+import jax
+
+from distributed_tensorflow_tpu.data.loaders import load_lm_dataset
+from distributed_tensorflow_tpu.engines import (
+    SeqParallelEngine, SyncEngine, Trainer)
+from distributed_tensorflow_tpu.models import create_model
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+
+def main(seq_parallel: int = 1) -> None:
+    train = load_lm_dataset(seq_len=64, vocab_size=128)
+    test = load_lm_dataset(seq_len=64, vocab_size=128, split="test")
+
+    total = jax.device_count()
+    if seq_parallel > 1:
+        dp = total // seq_parallel
+        mesh = meshlib.create_mesh(total, shape=(dp, seq_parallel),
+                                   axis_names=("data", "seq"))
+        model = create_model("gpt", num_classes=train.num_classes,
+                             hidden=64, layers=2, heads=4, ffn=128,
+                             max_len=64, attention_impl="ring_flash")
+        engine = SeqParallelEngine(model, mesh=mesh, learning_rate=3e-3)
+    else:
+        dp = total
+        mesh = meshlib.create_mesh(total)
+        # 'flash' = the Pallas causal kernel (interpret mode off-TPU)
+        model = create_model("gpt", num_classes=train.num_classes,
+                             hidden=64, layers=2, heads=4, ffn=128,
+                             max_len=64, attention_impl="flash")
+        engine = SyncEngine(model, mesh=mesh, learning_rate=3e-3)
+
+    trainer = Trainer(None, engine=engine)
+    fit = trainer.fit(train, epochs=2, batch_size=8 * dp, log_every=20)
+    ev = trainer.evaluate(test, batch_size=64)
+    print(f"steps={fit['steps']}  elapsed={fit['elapsed']:.1f}s  "
+          f"token-accuracy={ev['accuracy']:.3f}  perplexity-proxy "
+          f"loss={ev['loss']:.3f}")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-parallel", type=int, default=1)
+    main(p.parse_args().seq_parallel)

@@ -1,0 +1,123 @@
+"""Optimizer-side features: gradient accumulation and LR schedules.
+
+The reference's optimizer story is a constructor-default Adam applied
+forever (reference server.py:52-55); these are the TPU-native extensions
+that transformer-scale training needs — both parity-tested, not just smoke-
+tested.
+"""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.data.loaders import load_dataset
+from distributed_tensorflow_tpu.engines import SyncEngine
+from distributed_tensorflow_tpu.models import create_model
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+from distributed_tensorflow_tpu.utils.harness import (
+    ExperimentConfig, make_lr_schedule, run)
+
+
+@pytest.fixture(scope="module")
+def mnist():
+    return load_dataset("mnist", split="train")
+
+
+# ------------------------------------------------------ grad accumulation
+
+
+def test_grad_accum_matches_plain(mnist):
+    """K microbatches accumulated inside the step must equal the one-shot
+    step on the same global batch (SGD + no dropout: exact math, no rng)."""
+    x, y = mnist.x[:64], mnist.y[:64]
+    model = create_model("mlp", hidden=32, dropout_rate=0.0)
+    mesh = meshlib.create_mesh(8)
+
+    def train(k):
+        eng = SyncEngine(model, optimizer=optax.sgd(0.1), mesh=mesh,
+                         grad_accum=k)
+        s = eng.init_state(jax.random.key(0), x)
+        for _ in range(2):
+            xs, ys = eng.shard_batch(x, y)
+            s, m = eng.step(s, xs, ys)
+        return s, m
+
+    s1, m1 = train(1)
+    s4, m4 = train(4)
+    for a, b in zip(jax.tree.leaves(jax.device_get(s1.params)),
+                    jax.tree.leaves(jax.device_get(s4.params))):
+        np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-5)
+    assert float(m1["loss"]) == pytest.approx(float(m4["loss"]), abs=1e-5)
+
+
+def test_grad_accum_indivisible_batch_rejected(mnist):
+    eng = SyncEngine(create_model("mlp", hidden=32),
+                     mesh=meshlib.create_mesh(8), grad_accum=3)
+    s = eng.init_state(jax.random.key(0), mnist.x[:8])
+    xs, ys = eng.shard_batch(mnist.x[:32], mnist.y[:32])  # 4 per device
+    with pytest.raises(ValueError, match="grad_accum"):
+        eng.step(s, xs, ys)
+
+
+def test_grad_accum_requires_dp_engine():
+    with pytest.raises(ValueError, match="grad_accum"):
+        run(ExperimentConfig(engine="fsdp", grad_accum=2, n_devices=8))
+    with pytest.raises(ValueError, match="grad_accum"):
+        run(ExperimentConfig(model="bert_tiny", dataset="glue_synth",
+                             tensor_parallel=4, grad_accum=2, n_devices=8))
+
+
+# ------------------------------------------------------------ LR schedules
+
+
+def test_lr_schedule_shapes():
+    cfg = ExperimentConfig(learning_rate=1e-2, lr_schedule="cosine",
+                           warmup_steps=10)
+    s = make_lr_schedule(cfg, total_steps=100)
+    assert float(s(0)) == pytest.approx(0.0, abs=1e-8)
+    assert float(s(10)) == pytest.approx(1e-2, rel=1e-3)
+    assert float(s(100)) < 1e-3  # decayed
+
+    cfg = ExperimentConfig(learning_rate=1e-2, lr_schedule="linear",
+                           warmup_steps=0)
+    s = make_lr_schedule(cfg, total_steps=50)
+    assert float(s(0)) == pytest.approx(1e-2, rel=1e-6)
+    assert float(s(50)) == pytest.approx(0.0, abs=1e-8)
+
+    # warmup + constant: ramps, then holds
+    cfg = ExperimentConfig(learning_rate=1e-2, lr_schedule="constant",
+                           warmup_steps=5)
+    s = make_lr_schedule(cfg, total_steps=50)
+    assert float(s(1)) < 1e-2
+    assert float(s(40)) == pytest.approx(1e-2, rel=1e-6)
+
+    # default: no schedule object at all (engines use stock adam)
+    assert make_lr_schedule(ExperimentConfig(), 100) is None
+
+    with pytest.raises(ValueError, match="lr_schedule"):
+        make_lr_schedule(ExperimentConfig(lr_schedule="step"), 100)
+
+
+def _tiny_mnist_fn(batch_size, type="train", **kw):
+    return load_dataset("mnist", split=type, n_synthetic_train=256,
+                        n_synthetic_test=128)
+
+
+def test_harness_warmup_cosine_trains():
+    summary = run(ExperimentConfig(
+        engine="sync", model="mlp", n_devices=8, batch_size=4, epochs=1,
+        lr_schedule="cosine", warmup_steps=3, grad_accum=2, log_every=0,
+        dataset_fn=_tiny_mnist_fn))
+    assert np.isfinite(summary["test_loss"])
+    assert summary["test_accuracy"] > 0.5  # synthetic mnist learns fast
+
+
+def test_cli_flags_reach_config():
+    """--lr-schedule/--warmup-steps/--grad-accum parse and run end-to-end."""
+    from distributed_tensorflow_tpu.cli import main
+
+    summary = main(["-m", "t", "-n", "8", "-b", "4", "--lr-schedule",
+                    "linear", "--warmup-steps", "2", "--grad-accum", "2",
+                    "--log-every", "0"], dataset_fn=_tiny_mnist_fn)
+    assert np.isfinite(summary["test_loss"])
