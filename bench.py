@@ -463,19 +463,26 @@ def gpt_train_flops_per_token(hidden: int, layers: int, ffn: int,
     return 3.0 * fwd
 
 
-def bench_lm(batch: int = 8, seq_len: int = 1024, vocab: int = 32768,
+def bench_lm(batch: int = 8, seq_len: int = 1024, vocab: int = 16384,
              hidden: int = 512, layers: int = 8, heads: int = 8,
              ffn: int = 2048) -> None:
     """Training throughput (tokens/sec/chip) + MFU of a GPT-2-small-ish
     decoder LM in bf16, flash vs dense attention — the transformer
     counterpart of the default CNN bench, same differenced-scan-window
-    protocol."""
+    protocol.  Progress goes to stderr (compiles of a model this size take
+    minutes through a tunnel; a silent multi-minute run is
+    indistinguishable from a hang)."""
+    import sys
+
     import jax
     import jax.numpy as jnp
 
     from distributed_tensorflow_tpu.engines import SyncEngine
     from distributed_tensorflow_tpu.models import create_model
     from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+    def note(msg):
+        print(f"[bench --lm] {msg}", file=sys.stderr, flush=True)
 
     mesh = meshlib.create_mesh()
     n = mesh.shape[meshlib.DATA_AXIS]
@@ -491,6 +498,7 @@ def bench_lm(batch: int = 8, seq_len: int = 1024, vocab: int = 32768,
 
     rows = {}
     for impl in ("dense", "flash"):
+        t_impl = time.perf_counter()
         model = create_model(
             "gpt", num_classes=vocab, hidden=hidden, layers=layers,
             heads=heads, ffn=ffn, max_len=seq_len, dropout_rate=0.0,
@@ -498,22 +506,25 @@ def bench_lm(batch: int = 8, seq_len: int = 1024, vocab: int = 32768,
         eng = SyncEngine(model, mesh=mesh)
         state = eng.init_state(jax.random.key(0), x[:n])
         xs, ys = eng.shard_batch(x, y)
-        for _ in range(3):
-            state, m = eng.step(state, xs, ys)
+        state, m = eng.step(state, xs, ys)  # compile the single step
         _sync(state)
+        note(f"{impl}: step compiled in {time.perf_counter() - t_impl:.0f}s")
 
         def scan_body(st, _):
             st, _m = eng.step(st, xs, ys)
             return st, None
 
-        short, long = 5, 25
+        short, long = 3, 13
         runs = {k: jax.jit(lambda st, k=k: jax.lax.scan(
             scan_body, st, None, length=k)[0]) for k in (short, long)}
-        for run in runs.values():
+        for k, run in runs.items():
+            t0 = time.perf_counter()
             state = run(state)
-        _sync(state)
+            _sync(state)
+            note(f"{impl}: scan({k}) compiled+ran in "
+                 f"{time.perf_counter() - t0:.0f}s")
         rates = []
-        for _ in range(REPEATS):
+        for rep in range(REPEATS):
             t = {}
             for k, run in runs.items():
                 t0 = time.perf_counter()
@@ -522,6 +533,8 @@ def bench_lm(batch: int = 8, seq_len: int = 1024, vocab: int = 32768,
                 t[k] = time.perf_counter() - t0
             per_step = (t[long] - t[short]) / (long - short)
             rates.append(tokens_per_step / per_step)
+            note(f"{impl}: rep {rep}: "
+                 f"{rates[-1] / 1e3:.1f}k tokens/s")
         med, spread = _median_spread(rates)
         rows[impl] = {
             "tokens_per_sec_per_chip": round(med / n, 1),

@@ -88,6 +88,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="microbatches accumulated per optimizer step "
                         "(sync/allreduce engines): ~K× less activation "
                         "memory at identical math")
+    p.add_argument("--weight-decay", type=float, default=0.0,
+                   help=">0: AdamW decoupled weight decay")
+    p.add_argument("--clip-norm", type=float, default=0.0,
+                   help=">0: clip gradients to this global norm before the "
+                        "update")
     p.add_argument("--sync-every", type=int, default=10,
                    help="async engine: parameter-averaging period")
     p.add_argument("-d", "--degree", type=int, default=1,
@@ -226,6 +231,8 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         lr_schedule=args.lr_schedule,
         warmup_steps=args.warmup_steps,
         grad_accum=args.grad_accum,
+        weight_decay=args.weight_decay,
+        clip_norm=args.clip_norm,
         sync_every=args.sync_every,
         degree=args.degree,
         seed=args.seed,

@@ -37,6 +37,8 @@ device (the Megatron vocab-parallel-loss layout, for free from GSPMD).
 
 from __future__ import annotations
 
+import functools
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -61,6 +63,9 @@ class CausalSelfAttention(nn.Module):
     attention_impl: str = "dense"
     seq_axis: str = "seq"
     partition_model: bool = False
+    decode: bool = False       # KV-cache mode: one token in, attend against
+                               # everything cached (see ``generate``)
+    max_len: int = 512         # cache capacity in decode mode
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -81,7 +86,46 @@ class CausalSelfAttention(nn.Module):
             return h.reshape(h.shape[:-1] + (self.heads, head_dim))
 
         q, k, v = proj("query"), proj("key"), proj("value")
-        if self.attention_impl == "ring":
+        if self.decode:
+            # append this step's K/V at the cache cursor, attend q against
+            # the whole cache with a validity mask — O(max_len) per token
+            # instead of O(L²) re-prefill.  The cursor is causal masking:
+            # positions past it are NEG_INF'd, so no triangular mask needed.
+            if x.shape[1] != 1:
+                raise ValueError(
+                    f"decode mode consumes one token per call, got "
+                    f"sequence length {x.shape[1]}")
+            import jax
+
+            b = x.shape[0]
+            # has_variable is False exactly during .init(): create the cache
+            # zeros but do NOT write/advance — init-time mutations persist
+            # into the returned variables, which would hand `generate` a
+            # cache already holding the dummy init token (cursor at 1)
+            ready = self.has_variable("cache", "cached_key")
+            ck = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (b, self.max_len, self.heads, head_dim), self.dtype)
+            cv = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (b, self.max_len, self.heads, head_dim), self.dtype)
+            cur = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            if not ready:
+                out = dense_attention(q, k, v, causal=True)
+            else:
+                i = cur.value
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k, (0, i, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v, (0, i, 0, 0))
+                cur.value = i + 1
+                valid = (jnp.arange(self.max_len) <= i).astype(self.dtype)
+                out = dense_attention(
+                    q, ck.value, cv.value, causal=False,
+                    kv_mask=jnp.broadcast_to(valid[None, :],
+                                             (b, self.max_len)))
+        elif self.attention_impl == "ring":
             out = ring_attention(q, k, v, axis=self.seq_axis, causal=True)
         elif self.attention_impl == "ring_flash":
             out = ring_flash_attention(q, k, v, axis=self.seq_axis,
@@ -111,13 +155,16 @@ class GPTBlock(nn.Module):
     attention_impl: str = "dense"
     seq_axis: str = "seq"
     partition_model: bool = False
+    decode: bool = False
+    max_len: int = 512
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         tp = self.partition_model
         y = CausalSelfAttention(self.hidden, self.heads, self.attention_impl,
-                                self.seq_axis, tp, self.dtype)(
+                                self.seq_axis, tp, self.decode, self.max_len,
+                                self.dtype)(
                                     nn.LayerNorm(dtype=self.dtype)(x))
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
@@ -158,6 +205,7 @@ class GPTLM(nn.Module):
     attention_impl: str = "dense"
     seq_axis: str = "seq"
     partition_model: bool = False
+    decode: bool = False       # KV-cache autoregressive mode (see `generate`)
     tie_embeddings: bool = True
     dtype: jnp.dtype = jnp.float32
 
@@ -168,17 +216,34 @@ class GPTLM(nn.Module):
         seq_parallel = self.attention_impl in ("ring", "ring_flash",
                                                "ulysses")
         lq = token_ids.shape[1]
-        global_len = lq * (coll.axis_size(self.seq_axis) if seq_parallel
-                           else 1)
-        if global_len > self.max_len:
-            raise ValueError(
-                f"sequence length {global_len} exceeds max_len="
-                f"{self.max_len}; raise max_len or shorten the input")
-        if seq_parallel:
+        if self.decode:
+            if seq_parallel or self.partition_model:
+                raise ValueError(
+                    "decode mode is single-device (dense cache attention); "
+                    "clone the model with attention_impl='dense', "
+                    "partition_model=False — `generate` does this")
+            # the model-level cursor feeds the position embedding; each
+            # attention layer keeps its own cache cursor in lockstep.  Not
+            # advanced during .init() (same guard as the attention cache).
+            ready = self.has_variable("cache", "pos_index")
+            pcur = self.variable("cache", "pos_index",
+                                 lambda: jnp.zeros((), jnp.int32))
+            pos = pcur.value + jnp.arange(lq)[None, :]
+            if ready:
+                pcur.value = pcur.value + lq
+        elif seq_parallel:
+            if lq * coll.axis_size(self.seq_axis) > self.max_len:
+                raise ValueError(
+                    f"sequence length {lq * coll.axis_size(self.seq_axis)} "
+                    f"exceeds max_len={self.max_len}")
             # this device's token block starts at global position idx×lq
             offset = coll.axis_index(self.seq_axis) * lq
             pos = offset + jnp.arange(lq)[None, :]
         else:
+            if lq > self.max_len:
+                raise ValueError(
+                    f"sequence length {lq} exceeds max_len={self.max_len}; "
+                    f"raise max_len or shorten the input")
             pos = jnp.arange(lq)[None, :]
 
         embed = nn.Embed(
@@ -195,6 +260,7 @@ class GPTLM(nn.Module):
             x = GPTBlock(self.hidden, self.heads, self.ffn,
                          self.dropout_rate, self.attention_impl,
                          self.seq_axis, self.partition_model,
+                         self.decode, self.max_len,
                          self.dtype)(x, train)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.tie_embeddings:
@@ -209,6 +275,86 @@ class GPTLM(nn.Module):
                                   (None, meshlib.MODEL_AXIS),
                                   self.partition_model))(x)
         return logits.astype(jnp.float32)
+
+
+def generate(model: GPTLM, params, prompt, max_new_tokens: int, *,
+             temperature: float = 1.0, greedy: bool = False, rng=None):
+    """Autoregressive sampling with a KV cache: (B, Lp) prompt →
+    (B, max_new_tokens) continuation.
+
+    The inference counterpart the training framework would otherwise lack
+    (no reference counterpart — the reference has no sequence models at
+    all, SURVEY.md §2.2).  The model is cloned into decode mode (dense
+    cache attention, dropout off); prompt tokens prefill the cache one at a
+    time under `lax.scan`, then each new token costs one O(max_len)
+    cache-attention step instead of an O(L²) re-prefill.  ``greedy=True``
+    takes the argmax; otherwise tokens draw from
+    ``softmax(logits / temperature)``.  Cache correctness is oracle-tested
+    against teacher-forced full-forward rollout (tests/test_gpt.py).
+    """
+    import jax
+    from jax import lax
+
+    dm = model.clone(decode=True, attention_impl="dense",
+                     partition_model=False, dropout_rate=0.0)
+    prompt = jnp.asarray(prompt)
+    b, lp = prompt.shape
+    if lp + max_new_tokens > model.max_len:
+        raise ValueError(
+            f"prompt ({lp}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"cache capacity max_len={model.max_len}")
+    if rng is None:
+        rng = jax.random.key(0)
+
+    # cache shapes depend only on (batch, max_len): init with one token
+    cache = dm.init(jax.random.key(0), prompt[:, :1], train=False)["cache"]
+    run = _compiled_sampler(dm, max_new_tokens, bool(greedy),
+                            float(temperature))
+    return run(params, cache, prompt, rng)
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_sampler(dm: GPTLM, max_new_tokens: int, greedy: bool,
+                      temperature: float):
+    """One jitted prefill+decode program per (model config, length, mode).
+
+    linen Modules are frozen dataclasses (hashable by field values), so the
+    lru_cache makes repeated `generate` calls — per-eval-batch sampling
+    loops — reuse the compiled scans instead of paying full XLA compilation
+    on every call (params/cache/prompt are traced arguments, not closure
+    constants)."""
+    import jax
+    from jax import lax
+
+    def one(params, cache, tok):
+        """(cache, (B,) token) → (cache, (B, V) logits for the NEXT pos)."""
+        logits, upd = dm.apply({"params": params, "cache": cache},
+                               tok[:, None], train=False, mutable=["cache"])
+        return upd["cache"], logits[:, -1]
+
+    @jax.jit
+    def run(params, cache, prompt, rng):
+        # prefill: all but the last prompt token (their logits are unused)
+        cache, _ = lax.scan(lambda c, t: (one(params, c, t)[0], None),
+                            cache, prompt[:, :-1].T)
+
+        def gen(carry, _):
+            cache, tok, rng = carry
+            cache, logits = one(params, cache, tok)
+            rng, sub = jax.random.split(rng)
+            if greedy:
+                nxt = logits.argmax(-1)
+            else:
+                nxt = jax.random.categorical(
+                    sub, logits / max(temperature, 1e-6))
+            nxt = nxt.astype(tok.dtype)
+            return (cache, nxt, rng), nxt
+
+        (_, _, _), toks = lax.scan(gen, (cache, prompt[:, -1], rng),
+                                   None, length=max_new_tokens)
+        return toks.T  # (B, max_new_tokens)
+
+    return run
 
 
 # --------------------------------------------------------------------------

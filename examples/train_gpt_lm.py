@@ -43,18 +43,31 @@ def main(seq_parallel: int = 1) -> None:
     else:
         dp = total
         mesh = meshlib.create_mesh(total)
-        # 'flash' = the Pallas causal kernel (interpret mode off-TPU)
+        # the Pallas causal kernel on TPU; dense on CPU (interpret-mode
+        # Pallas is orders of magnitude slower than XLA there — right for
+        # correctness tests, wrong for a demo)
+        impl = "flash" if jax.default_backend() == "tpu" else "dense"
         model = create_model("gpt", num_classes=train.num_classes,
                              hidden=64, layers=2, heads=4, ffn=128,
-                             max_len=64, attention_impl="flash")
+                             max_len=64, attention_impl=impl)
         engine = SyncEngine(model, mesh=mesh, learning_rate=3e-3)
 
     trainer = Trainer(None, engine=engine)
-    fit = trainer.fit(train, epochs=2, batch_size=8 * dp, log_every=20)
+    fit = trainer.fit(train, epochs=1, batch_size=8 * dp, log_every=20)
     ev = trainer.evaluate(test, batch_size=64)
     print(f"steps={fit['steps']}  elapsed={fit['elapsed']:.1f}s  "
           f"token-accuracy={ev['accuracy']:.3f}  perplexity-proxy "
           f"loss={ev['loss']:.3f}")
+
+    # sample a continuation with the KV cache (greedy): the trained chain
+    # model should keep producing plausible transitions
+    from distributed_tensorflow_tpu.models.gpt import generate
+
+    params = jax.device_get(engine.eval_params(trainer.state))
+    cont = generate(model, params, test.x[:2, :16], max_new_tokens=16,
+                    greedy=True)
+    print("prompt :", test.x[0, :16].tolist())
+    print("sampled:", cont[0].tolist())
 
 
 if __name__ == "__main__":
