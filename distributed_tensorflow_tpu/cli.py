@@ -110,6 +110,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "schedule with the Pallas flash kernel as local "
                         "math); flash = single-device Pallas kernel, valid "
                         "only with -sp 1 (sequence models)")
+    p.add_argument("--positional", default="learned",
+                   choices=["learned", "rope"],
+                   help="GPT position encoding: learned table | RoPE "
+                        "(rotary, no table — q/k rotated by position)")
     p.add_argument("-tp", "--tensor-parallel", type=int, default=1,
                    help="shard weight matrices over this many devices "
                         "(Megatron-style TP; MLP family)")
@@ -241,6 +245,7 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         supervisor_address=args.supervisor,
         seq_parallel=args.seq_parallel,
         attention_impl=args.attention,
+        positional=args.positional,
         tensor_parallel=args.tensor_parallel,
         pipeline_parallel=args.pipeline_parallel,
         microbatches=args.microbatches,

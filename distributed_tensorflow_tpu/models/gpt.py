@@ -55,6 +55,26 @@ def _part(init, spec, enabled: bool):
     return nn.with_partitioning(init, spec) if enabled else init
 
 
+def apply_rope(x, pos, base: float = 10000.0):
+    """Rotary position embedding over the head dim (half-split layout).
+
+    ``x``: (B, L, H, D) with D even; ``pos``: (B, L) or (1, L) absolute
+    positions.  Rotation is a per-position preprocessing of q/k, so it
+    composes unchanged with every attention impl — dense, the Pallas flash
+    kernel, and the ring/Ulysses schedules (whose blocks receive globally
+    offset positions) — and with the KV cache (the cached k is stored
+    already rotated at its own position)."""
+    d2 = x.shape[-1] // 2
+    inv = base ** (-jnp.arange(d2, dtype=jnp.float32) / d2)
+    ang = pos.astype(jnp.float32)[..., None] * inv        # (B, L, D/2)
+    cos = jnp.cos(ang)[:, :, None, :]                     # (B, L, 1, D/2)
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., :d2].astype(jnp.float32), x[..., d2:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
 class CausalSelfAttention(nn.Module):
     """Multi-head causal self-attention with pluggable block math."""
 
@@ -66,12 +86,16 @@ class CausalSelfAttention(nn.Module):
     decode: bool = False       # KV-cache mode: one token in, attend against
                                # everything cached (see ``generate``)
     max_len: int = 512         # cache capacity in decode mode
+    rope: bool = False         # rotate q/k by position (RoPE) — requires
+                               # the caller to pass ``pos``
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, pos=None):
         head_dim = self.hidden // self.heads
         tp = self.partition_model
+        if self.rope and pos is None:
+            raise ValueError("rope=True needs the caller to pass positions")
 
         # column-parallel QKV (packed output dim sharded over 'model');
         # plain Dense for the same partial-manual-shard_map reason as BERT
@@ -86,11 +110,19 @@ class CausalSelfAttention(nn.Module):
             return h.reshape(h.shape[:-1] + (self.heads, head_dim))
 
         q, k, v = proj("query"), proj("key"), proj("value")
+        if self.rope:
+            q, k = apply_rope(q, pos), apply_rope(k, pos)
         if self.decode:
             # append this step's K/V at the cache cursor, attend q against
             # the whole cache with a validity mask — O(max_len) per token
             # instead of O(L²) re-prefill.  The cursor is causal masking:
             # positions past it are NEG_INF'd, so no triangular mask needed.
+            # CONTRACT: at most max_len tokens total.  The cursor is a
+            # traced value, so overflow cannot raise here — past capacity,
+            # dynamic_update_slice clamps and the newest token silently
+            # overwrites slot max_len-1.  `generate` (the supported entry)
+            # checks prompt+max_new_tokens against max_len eagerly; direct
+            # decode-API users own the same bound.
             if x.shape[1] != 1:
                 raise ValueError(
                     f"decode mode consumes one token per call, got "
@@ -157,15 +189,16 @@ class GPTBlock(nn.Module):
     partition_model: bool = False
     decode: bool = False
     max_len: int = 512
+    rope: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, pos=None):
         tp = self.partition_model
         y = CausalSelfAttention(self.hidden, self.heads, self.attention_impl,
                                 self.seq_axis, tp, self.decode, self.max_len,
-                                self.dtype)(
-                                    nn.LayerNorm(dtype=self.dtype)(x))
+                                self.rope, self.dtype)(
+                                    nn.LayerNorm(dtype=self.dtype)(x), pos)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
         # Megatron FFN: column-parallel up, row-parallel down
@@ -206,6 +239,9 @@ class GPTLM(nn.Module):
     seq_axis: str = "seq"
     partition_model: bool = False
     decode: bool = False       # KV-cache autoregressive mode (see `generate`)
+    positional: str = "learned"  # learned | rope (rotary: no position
+                                 # table; q/k rotated by absolute position
+                                 # in every attention layer)
     tie_embeddings: bool = True
     dtype: jnp.dtype = jnp.float32
 
@@ -252,16 +288,21 @@ class GPTLM(nn.Module):
             embedding_init=_part(nn.linear.default_embed_init,
                                  (meshlib.MODEL_AXIS, None),
                                  self.partition_model))
+        if self.positional not in ("learned", "rope"):
+            raise ValueError(
+                f"unknown positional '{self.positional}'; learned | rope")
+        rope = self.positional == "rope"
         x = embed(token_ids)
-        x = x + nn.Embed(self.max_len, self.hidden, dtype=self.dtype,
-                         name="pos_embed")(pos)
+        if not rope:
+            x = x + nn.Embed(self.max_len, self.hidden, dtype=self.dtype,
+                             name="pos_embed")(pos)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         for _ in range(self.layers):
             x = GPTBlock(self.hidden, self.heads, self.ffn,
                          self.dropout_rate, self.attention_impl,
                          self.seq_axis, self.partition_model,
-                         self.decode, self.max_len,
-                         self.dtype)(x, train)
+                         self.decode, self.max_len, rope,
+                         self.dtype)(x, train, pos if rope else None)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.tie_embeddings:
             # tied head: contraction against the (possibly vocab-sharded)
@@ -306,8 +347,15 @@ def generate(model: GPTLM, params, prompt, max_new_tokens: int, *,
     if rng is None:
         rng = jax.random.key(0)
 
-    # cache shapes depend only on (batch, max_len): init with one token
-    cache = dm.init(jax.random.key(0), prompt[:, :1], train=False)["cache"]
+    # fresh zero caches: shapes from an abstract init (eval_shape runs no
+    # FLOPs — an eager dm.init here would pay a full unjitted forward pass
+    # per generate call, dominating the cost the compiled-sampler cache
+    # exists to avoid).  Every cache variable initializes to zeros, so
+    # zeros-from-shape IS the init value.
+    cache_shapes = jax.eval_shape(
+        lambda: dm.init(jax.random.key(0), prompt[:, :1],
+                        train=False))["cache"]
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
     run = _compiled_sampler(dm, max_new_tokens, bool(greedy),
                             float(temperature))
     return run(params, cache, prompt, rng)
@@ -371,12 +419,14 @@ def _compiled_sampler(dm: GPTLM, max_new_tokens: int, greedy: bool,
 
 
 class GPTPipeEmbed(nn.Module):
-    """Input stage: token + position embeddings."""
+    """Input stage: token (+ learned position) embeddings; under RoPE the
+    position table disappears and rotation happens inside each block."""
 
     vocab_size: int = 256
     hidden: int = 128
     max_len: int = 512
     partition_model: bool = False
+    rope: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -385,33 +435,41 @@ class GPTPipeEmbed(nn.Module):
             raise ValueError(
                 f"sequence length {token_ids.shape[1]} exceeds "
                 f"max_len={self.max_len}")
-        pos = jnp.arange(token_ids.shape[1])[None, :]
         x = nn.Embed(
             self.vocab_size, self.hidden, dtype=self.dtype,
             embedding_init=_part(nn.linear.default_embed_init,
                                  (meshlib.MODEL_AXIS, None),
                                  self.partition_model))(token_ids)
+        if self.rope:
+            return x
+        pos = jnp.arange(token_ids.shape[1])[None, :]
         return x + nn.Embed(self.max_len, self.hidden,
                             dtype=self.dtype)(pos)
 
 
 class GPTPipeBlock(nn.Module):
-    """One pipeline stage: ``layers_per_stage`` pre-LN decoder blocks."""
+    """One pipeline stage: ``layers_per_stage`` pre-LN decoder blocks.
+
+    Pipeline microbatches carry FULL sequences (only the batch splits), so
+    RoPE positions are simply arange(L) — no cross-stage offsets."""
 
     hidden: int = 128
     heads: int = 4
     ffn: int = 512
     layers_per_stage: int = 1
     partition_model: bool = False
+    rope: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
+        pos = jnp.arange(x.shape[1])[None, :] if self.rope else None
         for _ in range(self.layers_per_stage):
             x = GPTBlock(self.hidden, self.heads, self.ffn,
                          dropout_rate=0.0, attention_impl="dense",
                          partition_model=self.partition_model,
-                         dtype=self.dtype)(x)
+                         rope=self.rope,
+                         dtype=self.dtype)(x, pos=pos)
         return x
 
 
@@ -442,20 +500,28 @@ def gpt_pipeline_stages(
     max_len: int = 512,
     layers_per_stage: int = 1,
     partition_model: bool = False,
+    positional: str = "learned",
     dtype: jnp.dtype = jnp.float32,
     num_classes: int | None = None,  # alias for vocab_size (harness passes it)
 ):
     """(embed, block, head) for ``PipelineEngine(stages=...)``: a GPT decoder
     of depth ``pipe_axis_size × layers_per_stage``.  ``partition_model=True``
-    adds Megatron TP annotations for pp×tp."""
+    adds Megatron TP annotations for pp×tp; ``positional='rope'`` drops the
+    position table and rotates q/k inside each block."""
     if num_classes is not None:
         vocab_size = num_classes
+    if positional not in ("learned", "rope"):
+        raise ValueError(
+            f"unknown positional '{positional}'; learned | rope")
+    rope = positional == "rope"
     return (
         GPTPipeEmbed(vocab_size=vocab_size, hidden=hidden, max_len=max_len,
-                     partition_model=partition_model, dtype=dtype),
+                     partition_model=partition_model, rope=rope,
+                     dtype=dtype),
         GPTPipeBlock(hidden=hidden, heads=heads, ffn=ffn,
                      layers_per_stage=layers_per_stage,
-                     partition_model=partition_model, dtype=dtype),
+                     partition_model=partition_model, rope=rope,
+                     dtype=dtype),
         GPTPipeHead(vocab_size=vocab_size, hidden=hidden,
                     partition_model=partition_model, dtype=dtype),
     )

@@ -75,6 +75,7 @@ class ExperimentConfig:
     attention_impl: str = "ring"           # ring | ring_flash | ulysses (when
                                            # seq_parallel>1); flash (Pallas
                                            # kernel) when seq_parallel==1
+    positional: str = "learned"            # GPT positions: learned | rope
     tensor_parallel: int = 1               # >1: shard weights over a 'model'
                                            # mesh axis (Megatron-style TP)
     pipeline_parallel: int = 1             # >1: shard stages over a 'pipe'
@@ -275,6 +276,8 @@ def _resolve_model(config: ExperimentConfig, num_classes: int):
                 f"models; the model_fn owns its dtype", stacklevel=2)
         return config.model_fn()
     kw = {}
+    if config.model in _LM_MODELS and config.positional != "learned":
+        kw["positional"] = config.positional
     if config.model in ("moe", "moe_mlp"):
         # router_top_k is a MODEL knob — it applies under any engine (a
         # -ep 1 run still routes).  router_z_weight is an ENGINE knob that
@@ -443,6 +446,8 @@ def _sequence_model(config: ExperimentConfig, train_ds, mode: str, **kw):
         return config.model_fn()
     if config.model in _SEQUENCE_MODELS:
         _require_token_data(train_ds, config, mode)
+        if config.model in _LM_MODELS and config.positional != "learned":
+            kw["positional"] = config.positional
         return modellib.create_model(
             config.model, num_classes=train_ds.num_classes,
             dtype=config.dtype, **kw)
@@ -465,6 +470,7 @@ def _pipeline_stages(config: ExperimentConfig, train_ds, test_ds, mode: str,
             hidden=config.pipeline_hidden,
             max_len=train_ds.x.shape[1],
             partition_model=partition_model,
+            positional=config.positional,
             dtype=dtype)
     from distributed_tensorflow_tpu.models.bert import bert_pipeline_stages
 
