@@ -114,6 +114,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["learned", "rope"],
                    help="GPT position encoding: learned table | RoPE "
                         "(rotary, no table — q/k rotated by position)")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="GPT grouped-query attention: K/V head count "
+                        "(< --heads; 1 = multi-query).  Shrinks the decode "
+                        "KV cache by heads/kv_heads")
     p.add_argument("-tp", "--tensor-parallel", type=int, default=1,
                    help="shard weight matrices over this many devices "
                         "(Megatron-style TP; MLP family)")
@@ -246,6 +250,7 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         seq_parallel=args.seq_parallel,
         attention_impl=args.attention,
         positional=args.positional,
+        kv_heads=args.kv_heads,
         tensor_parallel=args.tensor_parallel,
         pipeline_parallel=args.pipeline_parallel,
         microbatches=args.microbatches,

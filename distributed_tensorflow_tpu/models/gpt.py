@@ -88,6 +88,9 @@ class CausalSelfAttention(nn.Module):
     max_len: int = 512         # cache capacity in decode mode
     rope: bool = False         # rotate q/k by position (RoPE) — requires
                                # the caller to pass ``pos``
+    kv_heads: int | None = None  # GQA: K/V head count < query heads
+                               # (None = heads, standard MHA; 1 = MQA).
+                               # Shrinks the decode cache by heads/kv_heads.
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -96,22 +99,37 @@ class CausalSelfAttention(nn.Module):
         tp = self.partition_model
         if self.rope and pos is None:
             raise ValueError("rope=True needs the caller to pass positions")
+        kvh = self.kv_heads if self.kv_heads is not None else self.heads
+        if kvh < 1 or self.heads % kvh:
+            raise ValueError(
+                f"kv_heads must be a positive divisor of heads "
+                f"{self.heads}, got {kvh}")
 
         # column-parallel QKV (packed output dim sharded over 'model');
         # plain Dense for the same partial-manual-shard_map reason as BERT
-        # (models/bert.py:73-76)
-        def proj(name):
+        # (models/bert.py:73-76).  Under GQA the K/V projections emit
+        # kv_heads — the parameter and (cached) activation saving — and the
+        # heads broadcast back to query count right before the attention
+        # math (post-cache, so the cache stays small).
+        def proj(name, n_heads):
             h = nn.Dense(
-                self.heads * head_dim, dtype=self.dtype, name=name,
+                n_heads * head_dim, dtype=self.dtype, name=name,
                 kernel_init=_part(nn.initializers.lecun_normal(),
                                   (None, meshlib.MODEL_AXIS), tp),
                 bias_init=_part(nn.initializers.zeros_init(),
                                 (meshlib.MODEL_AXIS,), tp))(x)
-            return h.reshape(h.shape[:-1] + (self.heads, head_dim))
+            return h.reshape(h.shape[:-1] + (n_heads, head_dim))
 
-        q, k, v = proj("query"), proj("key"), proj("value")
+        q = proj("query", self.heads)
+        k, v = proj("key", kvh), proj("value", kvh)
         if self.rope:
             q, k = apply_rope(q, pos), apply_rope(k, pos)
+
+        def widen(t):
+            """kv_heads → heads by group broadcast (no-op for MHA)."""
+            if kvh == self.heads:
+                return t
+            return jnp.repeat(t, self.heads // kvh, axis=2)
         if self.decode:
             # append this step's K/V at the cache cursor, attend q against
             # the whole cache with a validity mask — O(max_len) per token
@@ -137,14 +155,14 @@ class CausalSelfAttention(nn.Module):
             ready = self.has_variable("cache", "cached_key")
             ck = self.variable(
                 "cache", "cached_key", jnp.zeros,
-                (b, self.max_len, self.heads, head_dim), self.dtype)
+                (b, self.max_len, kvh, head_dim), self.dtype)
             cv = self.variable(
                 "cache", "cached_value", jnp.zeros,
-                (b, self.max_len, self.heads, head_dim), self.dtype)
+                (b, self.max_len, kvh, head_dim), self.dtype)
             cur = self.variable("cache", "cache_index",
                                 lambda: jnp.zeros((), jnp.int32))
             if not ready:
-                out = dense_attention(q, k, v, causal=True)
+                out = dense_attention(q, widen(k), widen(v), causal=True)
             else:
                 i = cur.value
                 ck.value = jax.lax.dynamic_update_slice(
@@ -154,21 +172,23 @@ class CausalSelfAttention(nn.Module):
                 cur.value = i + 1
                 valid = (jnp.arange(self.max_len) <= i).astype(self.dtype)
                 out = dense_attention(
-                    q, ck.value, cv.value, causal=False,
+                    q, widen(ck.value), widen(cv.value), causal=False,
                     kv_mask=jnp.broadcast_to(valid[None, :],
                                              (b, self.max_len)))
         elif self.attention_impl == "ring":
-            out = ring_attention(q, k, v, axis=self.seq_axis, causal=True)
+            out = ring_attention(q, widen(k), widen(v), axis=self.seq_axis,
+                                 causal=True)
         elif self.attention_impl == "ring_flash":
-            out = ring_flash_attention(q, k, v, axis=self.seq_axis,
-                                       causal=True)
+            out = ring_flash_attention(q, widen(k), widen(v),
+                                       axis=self.seq_axis, causal=True)
         elif self.attention_impl == "ulysses":
-            out = ulysses_attention(q, k, v, axis=self.seq_axis, causal=True)
+            out = ulysses_attention(q, widen(k), widen(v),
+                                    axis=self.seq_axis, causal=True)
         elif self.attention_impl == "flash":
             from distributed_tensorflow_tpu.ops import flash_attention
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(q, widen(k), widen(v), causal=True)
         else:
-            out = dense_attention(q, k, v, causal=True)
+            out = dense_attention(q, widen(k), widen(v), causal=True)
         out = out.reshape(out.shape[:-2] + (self.heads * head_dim,))
         # row-parallel output projection — the pair's single all-reduce
         return nn.Dense(
@@ -190,6 +210,7 @@ class GPTBlock(nn.Module):
     decode: bool = False
     max_len: int = 512
     rope: bool = False
+    kv_heads: int | None = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -197,7 +218,7 @@ class GPTBlock(nn.Module):
         tp = self.partition_model
         y = CausalSelfAttention(self.hidden, self.heads, self.attention_impl,
                                 self.seq_axis, tp, self.decode, self.max_len,
-                                self.rope, self.dtype)(
+                                self.rope, self.kv_heads, self.dtype)(
                                     nn.LayerNorm(dtype=self.dtype)(x), pos)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
@@ -242,6 +263,7 @@ class GPTLM(nn.Module):
     positional: str = "learned"  # learned | rope (rotary: no position
                                  # table; q/k rotated by absolute position
                                  # in every attention layer)
+    kv_heads: int | None = None  # GQA/MQA: K/V heads < query heads
     tie_embeddings: bool = True
     dtype: jnp.dtype = jnp.float32
 
@@ -301,7 +323,7 @@ class GPTLM(nn.Module):
             x = GPTBlock(self.hidden, self.heads, self.ffn,
                          self.dropout_rate, self.attention_impl,
                          self.seq_axis, self.partition_model,
-                         self.decode, self.max_len, rope,
+                         self.decode, self.max_len, rope, self.kv_heads,
                          self.dtype)(x, train, pos if rope else None)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.tie_embeddings:
@@ -459,6 +481,7 @@ class GPTPipeBlock(nn.Module):
     layers_per_stage: int = 1
     partition_model: bool = False
     rope: bool = False
+    kv_heads: int | None = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -468,7 +491,7 @@ class GPTPipeBlock(nn.Module):
             x = GPTBlock(self.hidden, self.heads, self.ffn,
                          dropout_rate=0.0, attention_impl="dense",
                          partition_model=self.partition_model,
-                         rope=self.rope,
+                         rope=self.rope, kv_heads=self.kv_heads,
                          dtype=self.dtype)(x, pos=pos)
         return x
 
@@ -501,6 +524,7 @@ def gpt_pipeline_stages(
     layers_per_stage: int = 1,
     partition_model: bool = False,
     positional: str = "learned",
+    kv_heads: int | None = None,
     dtype: jnp.dtype = jnp.float32,
     num_classes: int | None = None,  # alias for vocab_size (harness passes it)
 ):
@@ -521,7 +545,7 @@ def gpt_pipeline_stages(
         GPTPipeBlock(hidden=hidden, heads=heads, ffn=ffn,
                      layers_per_stage=layers_per_stage,
                      partition_model=partition_model, rope=rope,
-                     dtype=dtype),
+                     kv_heads=kv_heads, dtype=dtype),
         GPTPipeHead(vocab_size=vocab_size, hidden=hidden,
                     partition_model=partition_model, dtype=dtype),
     )

@@ -76,6 +76,7 @@ class ExperimentConfig:
                                            # seq_parallel>1); flash (Pallas
                                            # kernel) when seq_parallel==1
     positional: str = "learned"            # GPT positions: learned | rope
+    kv_heads: int | None = None            # GPT GQA: K/V heads < query heads
     tensor_parallel: int = 1               # >1: shard weights over a 'model'
                                            # mesh axis (Megatron-style TP)
     pipeline_parallel: int = 1             # >1: shard stages over a 'pipe'
@@ -262,6 +263,18 @@ def _decay_mask(params):
     return jax.tree_util.tree_map_with_path(keep, params)
 
 
+def _lm_model_kw(config: ExperimentConfig) -> dict[str, Any]:
+    """GPT-only model kwargs (--positional/--kv-heads) — only passed when
+    non-default so non-LM models never see unknown fields."""
+    kw: dict[str, Any] = {}
+    if config.model in _LM_MODELS:
+        if config.positional != "learned":
+            kw["positional"] = config.positional
+        if config.kv_heads is not None:
+            kw["kv_heads"] = config.kv_heads
+    return kw
+
+
 def _resolve_model(config: ExperimentConfig, num_classes: int):
     """Model for the data-parallel engines: plug-in ``model_fn`` wins (and
     owns its dtype — warn if --dtype would be silently ignored); registered
@@ -276,8 +289,7 @@ def _resolve_model(config: ExperimentConfig, num_classes: int):
                 f"models; the model_fn owns its dtype", stacklevel=2)
         return config.model_fn()
     kw = {}
-    if config.model in _LM_MODELS and config.positional != "learned":
-        kw["positional"] = config.positional
+    kw.update(_lm_model_kw(config))
     if config.model in ("moe", "moe_mlp"):
         # router_top_k is a MODEL knob — it applies under any engine (a
         # -ep 1 run still routes).  router_z_weight is an ENGINE knob that
@@ -446,8 +458,7 @@ def _sequence_model(config: ExperimentConfig, train_ds, mode: str, **kw):
         return config.model_fn()
     if config.model in _SEQUENCE_MODELS:
         _require_token_data(train_ds, config, mode)
-        if config.model in _LM_MODELS and config.positional != "learned":
-            kw["positional"] = config.positional
+        kw.update(_lm_model_kw(config))
         return modellib.create_model(
             config.model, num_classes=train_ds.num_classes,
             dtype=config.dtype, **kw)
@@ -471,6 +482,7 @@ def _pipeline_stages(config: ExperimentConfig, train_ds, test_ds, mode: str,
             max_len=train_ds.x.shape[1],
             partition_model=partition_model,
             positional=config.positional,
+            kv_heads=config.kv_heads,
             dtype=dtype)
     from distributed_tensorflow_tpu.models.bert import bert_pipeline_stages
 
