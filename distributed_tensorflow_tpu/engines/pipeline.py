@@ -193,11 +193,11 @@ class PipelineEngine(Engine):
                                 meshlib.PIPE_AXIS} <= set(mesh.axis_names):
             raise ValueError("PipelineEngine requires a ('data','pipe') mesh")
         extra = set(mesh.axis_names) - {meshlib.DATA_AXIS, meshlib.PIPE_AXIS,
-                                        meshlib.MODEL_AXIS}
+                                        meshlib.MODEL_AXIS, meshlib.SEQ_AXIS}
         if extra:
             raise ValueError(
                 f"unsupported mesh axes {sorted(extra)}; PipelineEngine "
-                f"composes data×pipe(×model)")
+                f"composes data×pipe(×model)(×seq)")
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown schedule '{schedule}'; "
                              f"choose 'gpipe' or '1f1b'")
@@ -207,6 +207,23 @@ class PipelineEngine(Engine):
         # stage params' with_partitioning annotations drive the in-stage
         # model-axis collectives (pp×tp)
         self.tp_n = mesh.shape.get(meshlib.MODEL_AXIS, 1)
+        # optional sequence/context parallelism inside each stage (pp×sp):
+        # 'seq' is a MANUAL axis — the stage blocks must run ring/Ulysses
+        # attention over it (e.g. models.gpt.gpt_pipeline_stages with
+        # attention_impl='ring', seq_axis='seq'); activations stay
+        # seq-sharded while they ride the pipe ppermute ring
+        self.sp_n = mesh.shape.get(meshlib.SEQ_AXIS, 1)
+        if self.sp_n > 1 and schedule == "1f1b":
+            # 1F1B gates the block forward/backward behind lax.cond on a
+            # pipe-varying predicate; a seq collective (the ring's ppermute)
+            # inside a partially-taken conditional aborts XLA's thunk
+            # executor (measured: CPU rendezvous abort) — the same rule the
+            # gpipe tick documents for embed/head.  GPipe keeps the block
+            # unconditional, so it is the schedule that composes with seq.
+            raise ValueError(
+                "schedule='1f1b' does not compose with a 'seq' mesh axis "
+                "(ring collectives cannot live inside the schedule's "
+                "conditionals); use schedule='gpipe' for pp×sp")
         if stages is not None:
             self.embed, self.block, self.head = stages
         else:
@@ -219,15 +236,50 @@ class PipelineEngine(Engine):
         super().__init__(model=None, optimizer=optimizer, mesh=mesh,
                          learning_rate=learning_rate)
 
+    # ------------------------------------------------------------- batches
+    def shard_batch(self, x, y, mask=None, process_local=False):
+        if self.sp_n == 1:
+            return super().shard_batch(x, y, mask, process_local)
+        if x.ndim < 2 or x.shape[1] % self.sp_n:
+            raise ValueError(
+                f"pp×sp needs (batch, seq, ...) input with seq divisible by "
+                f"the seq axis size {self.sp_n}, got shape {x.shape}")
+        xs = self._place(x, NamedSharding(
+            self.mesh, P(meshlib.DATA_AXIS, meshlib.SEQ_AXIS)), process_local)
+        y_spec = (P(meshlib.DATA_AXIS, meshlib.SEQ_AXIS) if y.ndim >= 2
+                  else P(meshlib.DATA_AXIS))
+        ys = self._place(y, NamedSharding(self.mesh, y_spec), process_local)
+        if mask is None:
+            return xs, ys
+        ms = self._place(mask, NamedSharding(self.mesh, P(meshlib.DATA_AXIS)),
+                         process_local)
+        return xs, ys, ms
+
     # ---------------------------------------------------------------- init
+    def _oracle_stages(self):
+        """Seq-disabled twins of (embed, block) with identical param
+        structure: ring/Ulysses collectives and seq-offset positions cannot
+        trace outside the manual shard_map, so init and the sequential
+        eval/parity oracle run the dense single-device algorithm."""
+        embed, block = self.embed, self.block
+        if getattr(embed, "seq_axis", None) is not None:
+            embed = embed.clone(seq_axis=None)
+        if getattr(block, "seq_axis", None) is not None:
+            block = block.clone(seq_axis=None)
+        if getattr(block, "attention_impl", "dense") in (
+                "ring", "ring_flash", "ulysses"):
+            block = block.clone(attention_impl="dense")
+        return embed, block
+
     def init_state(self, rng, sample_x) -> TrainState:
         x = jnp.asarray(sample_x[:1])
+        o_embed, o_block = self._oracle_stages()
         e_rng, b_rng, h_rng = jax.random.split(rng, 3)
-        embed_v = self.embed.init(e_rng, x)
+        embed_v = o_embed.init(e_rng, x)
         embed_p = nn.unbox(embed_v)["params"]
-        h = self.embed.apply({"params": embed_p}, x)
+        h = o_embed.apply({"params": embed_p}, x)
         blocks_p = jax.vmap(
-            lambda k: nn.unbox(self.block.init(k, h))["params"]
+            lambda k: nn.unbox(o_block.init(k, h))["params"]
         )(jax.random.split(b_rng, self.n_stages))
         head_v = self.head.init(h_rng, h)
         head_p = nn.unbox(head_v)["params"]
@@ -239,7 +291,7 @@ class PipelineEngine(Engine):
         # annotations when the stages carry them).  A single un-stacked
         # block init supplies the annotation specs; the stacked leaves get
         # 'pipe' prepended.
-        block_abs = jax.eval_shape(lambda k: self.block.init(k, h),
+        block_abs = jax.eval_shape(lambda k: o_block.init(k, h),
                                    jax.random.key(0))
         block_ann = nn.get_partition_spec(block_abs)["params"]
         stage_specs = {
@@ -258,11 +310,15 @@ class PipelineEngine(Engine):
     # ------------------------------------------------------------- forward
     def _sequential_logits(self, params, x):
         """Un-pipelined forward (scan over the stacked stages) — used for
-        eval and as the parity oracle in tests."""
-        h = self.embed.apply({"params": params["embed"]}, x)
+        eval and as the parity oracle in tests.  Uses the seq-disabled
+        stage twins: outside the manual shard_map the full sequence is in
+        one piece, so dense attention at global positions IS the oracle
+        semantics of the seq-sharded pipeline."""
+        o_embed, o_block = self._oracle_stages()
+        h = o_embed.apply({"params": params["embed"]}, x)
 
         def body(h, bp):
-            return self.block.apply({"params": bp}, h), None
+            return o_block.apply({"params": bp}, h), None
 
         h, _ = lax.scan(body, h, params["blocks"])
         return self.head.apply({"params": params["head"]}, h)
@@ -277,7 +333,12 @@ class PipelineEngine(Engine):
         tx = self.tx
         embed, block, head = self.embed, self.block, self.head
         M = self.microbatches
+        sp = self.sp_n
         data_axis, pipe_axis = meshlib.DATA_AXIS, meshlib.PIPE_AXIS
+        # with a manual 'seq' axis, per-device losses are per-token-block
+        # partial means: they reduce (and the AD-boundary psum runs) over
+        # all three axes, and the mean-gradient scale gains a 1/sp
+        seq_axes = (meshlib.SEQ_AXIS,) if sp > 1 else ()
 
         def device_step(state: TrainState, x, y):
             S = lax.axis_size(pipe_axis)
@@ -298,7 +359,7 @@ class PipelineEngine(Engine):
                 # partially-taken ConditionalThunk deadlocks/aborts), and
                 # hoisting it here also means one psum per step instead of
                 # one per tick.
-                both = (data_axis, pipe_axis)
+                both = (data_axis, pipe_axis) + seq_axes
                 embed_v = jax.tree.map(
                     lambda a: lax.pcast(a, both, to="varying"),
                     params["embed"])
@@ -334,6 +395,11 @@ class PipelineEngine(Engine):
                     yi = lax.pcast(yi, pipe_axis, to="varying")
                     valid = ((oi >= 0) & (oi < M)).astype(jnp.float32)
                     valid = lax.pcast(valid, pipe_axis, to="varying")
+                    if sp > 1:
+                        # loss/acc must come out fully varying to match the
+                        # zero branch; valid (tick-derived) starts invariant
+                        # over seq
+                        valid = lax.pcast(valid, seq_axes, to="varying")
 
                     def drain(h):
                         logits = head.apply({"params": head_v}, h)
@@ -345,9 +411,10 @@ class PipelineEngine(Engine):
                     # branch outputs must carry identical varying-axes
                     # types: loss/acc are (data, pipe)-varying, w pipe-only
                     zero_dp = lax.pcast(jnp.zeros((), jnp.float32),
-                                        (data_axis, pipe_axis), to="varying")
+                                        (data_axis, pipe_axis) + seq_axes,
+                                        to="varying")
                     zero_p = lax.pcast(jnp.zeros((), jnp.float32),
-                                       pipe_axis, to="varying")
+                                       (pipe_axis,) + seq_axes, to="varying")
                     loss_i, w, acc_i = lax.cond(
                         stage == S - 1, drain,
                         lambda h: (zero_dp, zero_p, zero_dp), h_out)
@@ -363,7 +430,8 @@ class PipelineEngine(Engine):
                     params["embed"], micro_x[0])
                 buf0 = jax.tree.map(
                     lambda a: lax.pcast(jnp.zeros(a.shape, a.dtype),
-                                        (data_axis, pipe_axis), to="varying"),
+                                        (data_axis, pipe_axis) + seq_axes,
+                                        to="varying"),
                     h0)
                 _, (losses, accs, ws) = lax.scan(
                     tick, buf0, jnp.arange(M + S - 1))
@@ -371,7 +439,7 @@ class PipelineEngine(Engine):
                 # over BOTH axes at the AD boundary yields the global batch
                 # mean (same mechanism as engines/sync.py)
                 local_sum = losses.sum()
-                scaled = local_sum / (M * n_data)
+                scaled = local_sum / (M * n_data * sp)
                 return scaled, (losses.sum(), accs.sum(), ws.sum())
 
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -379,9 +447,10 @@ class PipelineEngine(Engine):
             updates, opt_state = tx.update(grads, state.opt_state,
                                            state.params)
             params = optax.apply_updates(state.params, updates)
-            both = (data_axis, pipe_axis)
-            # w_sum depends only on the stage index → data-invariant; make it
-            # data-varying so it can ride the same two-axis psum
+            both = (data_axis, pipe_axis) + seq_axes
+            # w_sum is data-invariant (stage/tick-derived; the drain pcast
+            # already made it seq-varying when sp > 1) — add the data axis
+            # so it can ride the same all-axes psum
             w_sum = lax.pcast(w_sum, data_axis, to="varying")
             tot_w = lax.psum(w_sum, both)
             metrics = {
@@ -593,16 +662,23 @@ class PipelineEngine(Engine):
         compiled HLO (e.g. assert embed/head sit behind `conditional`s)."""
         compiled = {}
         manual = {meshlib.DATA_AXIS, meshlib.PIPE_AXIS}
+        if self.sp_n > 1:
+            manual.add(meshlib.SEQ_AXIS)
 
         def step_fn(state, x, y):
             if "fn" not in compiled:
                 spec = _pipe_spec_tree(state)
                 kw = ({"axis_names": manual}
                       if meshlib.MODEL_AXIS in self.mesh.axis_names else {})
+                if self.sp_n > 1:
+                    x_spec = P(meshlib.DATA_AXIS, meshlib.SEQ_AXIS)
+                    y_spec = (P(meshlib.DATA_AXIS, meshlib.SEQ_AXIS)
+                              if np.ndim(y) >= 2 else P(meshlib.DATA_AXIS))
+                else:
+                    x_spec = y_spec = P(meshlib.DATA_AXIS)
                 smapped = jax.shard_map(
                     device_step, mesh=self.mesh,
-                    in_specs=(spec, P(meshlib.DATA_AXIS),
-                              P(meshlib.DATA_AXIS)),
+                    in_specs=(spec, x_spec, y_spec),
                     out_specs=(spec, P()),
                     **kw,
                 )

@@ -442,21 +442,27 @@ def _compiled_sampler(dm: GPTLM, max_new_tokens: int, greedy: bool,
 
 class GPTPipeEmbed(nn.Module):
     """Input stage: token (+ learned position) embeddings; under RoPE the
-    position table disappears and rotation happens inside each block."""
+    position table disappears and rotation happens inside each block.
+
+    ``seq_axis`` set (pp×sp): the stage sees a seq-SHARDED token block, so
+    learned positions offset by block index × local length (global
+    positions, same as GPTLM's seq-parallel path)."""
 
     vocab_size: int = 256
     hidden: int = 128
     max_len: int = 512
     partition_model: bool = False
     rope: bool = False
+    seq_axis: str | None = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, token_ids):
-        if token_ids.shape[1] > self.max_len:
+        lq = token_ids.shape[1]
+        sp = coll.axis_size(self.seq_axis) if self.seq_axis else 1
+        if lq * sp > self.max_len:
             raise ValueError(
-                f"sequence length {token_ids.shape[1]} exceeds "
-                f"max_len={self.max_len}")
+                f"sequence length {lq * sp} exceeds max_len={self.max_len}")
         x = nn.Embed(
             self.vocab_size, self.hidden, dtype=self.dtype,
             embedding_init=_part(nn.linear.default_embed_init,
@@ -464,7 +470,9 @@ class GPTPipeEmbed(nn.Module):
                                  self.partition_model))(token_ids)
         if self.rope:
             return x
-        pos = jnp.arange(token_ids.shape[1])[None, :]
+        offset = (coll.axis_index(self.seq_axis) * lq if self.seq_axis
+                  else 0)
+        pos = offset + jnp.arange(lq)[None, :]
         return x + nn.Embed(self.max_len, self.hidden,
                             dtype=self.dtype)(pos)
 
@@ -472,8 +480,11 @@ class GPTPipeEmbed(nn.Module):
 class GPTPipeBlock(nn.Module):
     """One pipeline stage: ``layers_per_stage`` pre-LN decoder blocks.
 
-    Pipeline microbatches carry FULL sequences (only the batch splits), so
-    RoPE positions are simply arange(L) — no cross-stage offsets."""
+    Without a ``seq_axis``, pipeline microbatches carry FULL sequences
+    (only the batch splits), so RoPE positions are simply arange(L).  With
+    ``seq_axis`` set (pp×sp), the carry is a seq-sharded token block:
+    attention must be a sequence-parallel impl ('ring'/'ring_flash'/
+    'ulysses') and RoPE positions offset to global."""
 
     hidden: int = 128
     heads: int = 4
@@ -482,14 +493,28 @@ class GPTPipeBlock(nn.Module):
     partition_model: bool = False
     rope: bool = False
     kv_heads: int | None = None
+    attention_impl: str = "dense"
+    seq_axis: str | None = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        pos = jnp.arange(x.shape[1])[None, :] if self.rope else None
+        lq = x.shape[1]
+        if self.seq_axis and self.attention_impl == "dense":
+            raise ValueError(
+                "seq_axis set but attention_impl is 'dense' — dense "
+                "attention on a seq-sharded carry attends within local "
+                "blocks only; use ring/ring_flash/ulysses")
+        pos = None
+        if self.rope:
+            offset = (coll.axis_index(self.seq_axis) * lq if self.seq_axis
+                      else 0)
+            pos = offset + jnp.arange(lq)[None, :]
         for _ in range(self.layers_per_stage):
             x = GPTBlock(self.hidden, self.heads, self.ffn,
-                         dropout_rate=0.0, attention_impl="dense",
+                         dropout_rate=0.0,
+                         attention_impl=self.attention_impl,
+                         seq_axis=self.seq_axis or "seq",
                          partition_model=self.partition_model,
                          rope=self.rope, kv_heads=self.kv_heads,
                          dtype=self.dtype)(x, pos=pos)
@@ -525,13 +550,18 @@ def gpt_pipeline_stages(
     partition_model: bool = False,
     positional: str = "learned",
     kv_heads: int | None = None,
+    attention_impl: str = "dense",
+    seq_axis: str | None = None,
     dtype: jnp.dtype = jnp.float32,
     num_classes: int | None = None,  # alias for vocab_size (harness passes it)
 ):
     """(embed, block, head) for ``PipelineEngine(stages=...)``: a GPT decoder
     of depth ``pipe_axis_size × layers_per_stage``.  ``partition_model=True``
     adds Megatron TP annotations for pp×tp; ``positional='rope'`` drops the
-    position table and rotates q/k inside each block."""
+    position table and rotates q/k inside each block;
+    ``attention_impl='ring'`` (etc.) + ``seq_axis='seq'`` makes the stages
+    sequence-parallel for pp×sp (the carry rides the pipe ring as a
+    seq-sharded token block)."""
     if num_classes is not None:
         vocab_size = num_classes
     if positional not in ("learned", "rope"):
@@ -541,11 +571,12 @@ def gpt_pipeline_stages(
     return (
         GPTPipeEmbed(vocab_size=vocab_size, hidden=hidden, max_len=max_len,
                      partition_model=partition_model, rope=rope,
-                     dtype=dtype),
+                     seq_axis=seq_axis, dtype=dtype),
         GPTPipeBlock(hidden=hidden, heads=heads, ffn=ffn,
                      layers_per_stage=layers_per_stage,
                      partition_model=partition_model, rope=rope,
-                     kv_heads=kv_heads, dtype=dtype),
+                     kv_heads=kv_heads, attention_impl=attention_impl,
+                     seq_axis=seq_axis, dtype=dtype),
         GPTPipeHead(vocab_size=vocab_size, hidden=hidden,
                     partition_model=partition_model, dtype=dtype),
     )

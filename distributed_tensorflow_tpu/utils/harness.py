@@ -138,11 +138,14 @@ def _setup(config: ExperimentConfig) -> _Experiment:
             return _setup_pipeline_tp(config)
         if set(multi) == {"expert_parallel", "tensor_parallel"}:
             return _setup_expert_tp(config)
+        if set(multi) == {"pipeline_parallel", "seq_parallel"}:
+            return _setup_pipeline_sp(config)
         raise ValueError(
             f"{' and '.join(multi)} cannot be combined; composable pairs in "
             f"this release: tensor_parallel × seq_parallel (dp×tp×sp), "
-            f"pipeline_parallel × tensor_parallel (dp×pp×tp), and "
-            f"expert_parallel × tensor_parallel (dp×ep×tp)")
+            f"pipeline_parallel × tensor_parallel (dp×pp×tp), "
+            f"expert_parallel × tensor_parallel (dp×ep×tp), and "
+            f"pipeline_parallel × seq_parallel (dp×pp×sp)")
     if config.seq_parallel > 1:
         return _setup_seq_parallel(config)
     if config.tensor_parallel > 1:
@@ -468,9 +471,13 @@ def _sequence_model(config: ExperimentConfig, train_ds, mode: str, **kw):
 
 
 def _pipeline_stages(config: ExperimentConfig, train_ds, test_ds, mode: str,
-                     partition_model: bool = False):
+                     partition_model: bool = False,
+                     attention_impl: str = "dense",
+                     seq_axis: str | None = None):
     """(embed, block, head) for the pipeline setups, by model family:
-    BERT encoder (models/bert.py) or GPT decoder LM (models/gpt.py)."""
+    BERT encoder (models/bert.py) or GPT decoder LM (models/gpt.py).
+    ``attention_impl``/``seq_axis`` make the GPT stages sequence-parallel
+    for dp×pp×sp."""
     _require_token_data(train_ds, config, mode)
     dtype = modellib.resolve_dtype(config.dtype)
     if config.model in _LM_MODELS:
@@ -483,6 +490,8 @@ def _pipeline_stages(config: ExperimentConfig, train_ds, test_ds, mode: str,
             partition_model=partition_model,
             positional=config.positional,
             kv_heads=config.kv_heads,
+            attention_impl=attention_impl,
+            seq_axis=seq_axis,
             dtype=dtype)
     from distributed_tensorflow_tpu.models.bert import bert_pipeline_stages
 
@@ -639,6 +648,46 @@ def _setup_expert_parallel(config: ExperimentConfig,
                        global_batch=_global_batch(config, n_token_shards))
 
 
+def _setup_pipeline_sp(config: ExperimentConfig) -> _Experiment:
+    """dp×pp×sp: 3-D (data, pipe, seq) mesh — GPipe schedule manual over
+    (data, pipe), ring/Ulysses attention manual over 'seq' inside each
+    stage (engines/pipeline.py).  GPT decoder stages only: a seq-sharded
+    carry cannot serve a [CLS] classification head, and the LM's per-token
+    loss is what the schedule's drain reduces correctly."""
+    from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
+
+    if config.model not in _LM_MODELS or config.model_fn is not None:
+        raise ValueError(
+            f"pipeline_parallel×seq_parallel ships GPT decoder stages only "
+            f"(got --model {config.model}); custom models pass seq-aware "
+            f"stages to PipelineEngine directly")
+    if config.attention_impl == "flash":
+        raise ValueError(
+            "--attention flash is the single-device kernel; with "
+            "--seq-parallel use ring or ring_flash")
+    mesh, dp = _split_mesh(config, config.pipeline_parallel,
+                           "pipeline_parallel×seq_parallel",
+                           meshlib.PIPE_AXIS,
+                           (config.seq_parallel, meshlib.SEQ_AXIS))
+    train_ds, test_ds = _load_data(config)
+    stages = _pipeline_stages(config, train_ds, test_ds,
+                              "pipeline_parallel×seq_parallel",
+                              attention_impl=config.attention_impl,
+                              seq_axis=meshlib.SEQ_AXIS)
+    if (_global_batch(config, dp) // dp) % config.microbatches:
+        raise ValueError(
+            f"per-data-shard batch {_global_batch(config, dp) // dp} not "
+            f"divisible by microbatches {config.microbatches}")
+    engine = PipelineEngine(microbatches=config.microbatches, mesh=mesh,
+                            learning_rate=config.learning_rate,
+                            optimizer=_make_optimizer(
+                                config, train_ds, _global_batch(config, dp)),
+                            stages=stages,
+                            schedule=config.pipeline_schedule)
+    return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
+                       engine=engine, global_batch=_global_batch(config, dp))
+
+
 def _setup_expert_tp(config: ExperimentConfig) -> _Experiment:
     """dp×ep×tp — see _setup_expert_parallel(tp=...)."""
     return _setup_expert_parallel(config, tp=config.tensor_parallel)
@@ -743,6 +792,8 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
             engine_name = f"pipeline_tp[dp*pp*tp,{config.pipeline_schedule}]"
         elif config.expert_parallel > 1 and config.tensor_parallel > 1:
             engine_name = "expert_tp[dp*ep*tp]"
+        elif config.pipeline_parallel > 1 and config.seq_parallel > 1:
+            engine_name = f"pipeline_sp[dp*pp*sp,{config.attention_impl}]"
         elif config.seq_parallel > 1:
             engine_name = f"seq_parallel[{config.attention_impl}]"
         elif config.tensor_parallel > 1:
