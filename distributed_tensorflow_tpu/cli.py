@@ -36,6 +36,28 @@ import sys
 from distributed_tensorflow_tpu.utils.harness import ExperimentConfig, run
 
 
+def parse_model_args(pairs: list[str]) -> dict:
+    """KEY=VALUE list → kwargs dict with literal-ish value parsing."""
+    out = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise argparse.ArgumentTypeError(
+                f"--model-arg expects KEY=VALUE, got '{pair}'")
+        k, v = pair.split("=", 1)
+        if v.lower() in ("true", "false"):
+            out[k] = v.lower() == "true"
+        else:
+            for cast in (int, float):
+                try:
+                    out[k] = cast(v)
+                    break
+                except ValueError:
+                    continue
+            else:
+                out[k] = v
+    return out
+
+
 def str2bool(v: str) -> bool:
     """Parity with reference str2bool (reference initializer.py:59-67)."""
     if isinstance(v, bool):
@@ -118,6 +140,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GPT grouped-query attention: K/V head count "
                         "(< --heads; 1 = multi-query).  Shrinks the decode "
                         "KV cache by heads/kv_heads")
+    p.add_argument("--model-arg", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="extra model constructor field (repeatable), e.g. "
+                        "--model-arg hidden=256 --model-arg layers=4; "
+                        "values parse as int/float/bool when they look "
+                        "like one, else string")
     p.add_argument("-tp", "--tensor-parallel", type=int, default=1,
                    help="shard weight matrices over this many devices "
                         "(Megatron-style TP; MLP family)")
@@ -205,6 +233,11 @@ def main(argv: list[str] | None = None, *, model_fn=None,
     parser = build_parser()
     args = parser.parse_args(argv)
 
+    try:
+        model_args = parse_model_args(args.model_arg)
+    except argparse.ArgumentTypeError as bad:
+        parser.error(str(bad))  # clean usage error + exit 2, not a traceback
+
     if (args.task_type is None) != (args.server_address is None):
         # the reference dispatches on task_type alone (reference
         # initializer.py:147-155); silently running single-process when one
@@ -251,6 +284,7 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         attention_impl=args.attention,
         positional=args.positional,
         kv_heads=args.kv_heads,
+        model_args=model_args,
         tensor_parallel=args.tensor_parallel,
         pipeline_parallel=args.pipeline_parallel,
         microbatches=args.microbatches,

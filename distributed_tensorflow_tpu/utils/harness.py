@@ -77,6 +77,13 @@ class ExperimentConfig:
                                            # kernel) when seq_parallel==1
     positional: str = "learned"            # GPT positions: learned | rope
     kv_heads: int | None = None            # GPT GQA: K/V heads < query heads
+    model_args: dict | None = None         # extra model constructor fields
+                                           # (--model-arg KEY=VALUE): sizes
+                                           # like hidden/layers/heads for the
+                                           # registered models; applied on
+                                           # the DP and model-parallel
+                                           # paths (pipeline stages size via
+                                           # --pipeline-hidden instead)
     tensor_parallel: int = 1               # >1: shard weights over a 'model'
                                            # mesh axis (Megatron-style TP)
     pipeline_parallel: int = 1             # >1: shard stages over a 'pipe'
@@ -291,7 +298,7 @@ def _resolve_model(config: ExperimentConfig, num_classes: int):
                 f"--dtype {config.dtype} is ignored for plug-in model_fn "
                 f"models; the model_fn owns its dtype", stacklevel=2)
         return config.model_fn()
-    kw = {}
+    kw = dict(config.model_args or {})
     kw.update(_lm_model_kw(config))
     if config.model in ("moe", "moe_mlp"):
         # router_top_k is a MODEL knob — it applies under any engine (a
@@ -313,12 +320,14 @@ def _resolve_model(config: ExperimentConfig, num_classes: int):
                                      dtype=config.dtype, **kw)
     except TypeError as dtype_err:
         # user-register()ed Modules may not declare a dtype field; probe by
-        # retrying WITHOUT dtype — if that also fails, the factory has a
-        # genuine bug and the original error must surface, not a misleading
-        # dtype message
+        # retrying WITHOUT dtype but WITH the remaining kwargs — a typo'd
+        # --model-arg key must still fail loudly (the probe once dropped
+        # ALL kwargs, which silently trained the default-size model), and
+        # if the kwarg-preserving probe also fails the original error
+        # surfaces, not a misleading dtype message
         try:
             model = modellib.create_model(config.model,
-                                          num_classes=num_classes)
+                                          num_classes=num_classes, **kw)
         except TypeError:
             raise dtype_err
         if (modellib.resolve_dtype(config.dtype)
@@ -461,6 +470,7 @@ def _sequence_model(config: ExperimentConfig, train_ds, mode: str, **kw):
         return config.model_fn()
     if config.model in _SEQUENCE_MODELS:
         _require_token_data(train_ds, config, mode)
+        kw = {**(config.model_args or {}), **kw}
         kw.update(_lm_model_kw(config))
         return modellib.create_model(
             config.model, num_classes=train_ds.num_classes,
@@ -468,6 +478,16 @@ def _sequence_model(config: ExperimentConfig, train_ds, mode: str, **kw):
     raise ValueError(
         f"{mode} needs a sequence model ({'/'.join(_SEQUENCE_MODELS)}), got "
         f"--model {config.model}; pass model_fn for a custom model")
+
+
+def _reject_model_args(config: ExperimentConfig, mode: str) -> None:
+    """Pipeline stages are sized by --pipeline-hidden, not --model-arg —
+    reject rather than silently train a default-size model (same policy as
+    --router-z-weight outside EP)."""
+    if config.model_args:
+        raise ValueError(
+            f"--model-arg does not reach {mode} stage modules; size them "
+            f"with --pipeline-hidden (got {sorted(config.model_args)})")
 
 
 def _pipeline_stages(config: ExperimentConfig, train_ds, test_ds, mode: str,
@@ -531,6 +551,7 @@ def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
     over 'pipe'; --model picks the stage family — the built-in MLP stages or
     a BERT encoder split layer-per-stage (models/bert.py
     bert_pipeline_stages)."""
+    _reject_model_args(config, "pipeline_parallel")
     from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
 
     mesh, dp = _split_mesh(config, config.pipeline_parallel,
@@ -570,6 +591,7 @@ def _setup_pipeline_tp(config: ExperimentConfig) -> _Experiment:
     over (data, pipe), Megatron TP inside each stage as a GSPMD auto axis
     (engines/pipeline.py).  Sequence-model stages only (BERT encoder or GPT
     decoder): the built-in MLP stages carry no Megatron annotations."""
+    _reject_model_args(config, "pipeline_parallel×tensor_parallel")
     from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
 
     mesh, dp = _split_mesh(config, config.pipeline_parallel,
@@ -625,6 +647,7 @@ def _setup_expert_parallel(config: ExperimentConfig,
                 f"expert_parallel {config.expert_parallel}")
         model = modellib.create_model(
             "moe", num_classes=train_ds.num_classes,
+            **(config.model_args or {}),
             num_experts=config.num_experts, partition_experts=True,
             partition_model=tp > 1, router_top_k=config.router_top_k,
             dtype=config.dtype)
@@ -654,6 +677,7 @@ def _setup_pipeline_sp(config: ExperimentConfig) -> _Experiment:
     stage (engines/pipeline.py).  GPT decoder stages only: a seq-sharded
     carry cannot serve a [CLS] classification head, and the LM's per-token
     loss is what the schedule's drain reduces correctly."""
+    _reject_model_args(config, "pipeline_parallel×seq_parallel")
     from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
 
     if config.model not in _LM_MODELS or config.model_fn is not None:
@@ -831,6 +855,11 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
             "examples_per_sec_per_device": fit["examples_per_sec"] / total_devices,
             "test_accuracy": ev["accuracy"],
             "test_loss": ev["loss"],
+            # next-token cross-entropy exponentiated = perplexity, the
+            # standard LM quality number (reported only for LM models —
+            # exp(classification loss) would be meaningless)
+            **({"test_perplexity": float(np.exp(min(ev["loss"], 80.0)))}
+               if config.model in _LM_MODELS else {}),
         }
         sink.emit("summary", **summary)
         return summary

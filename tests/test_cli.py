@@ -173,3 +173,50 @@ def test_cli_user_plugin_model_and_dataset_fn():
     assert built.get("model")
     assert summary["steps"] > 0
     assert summary["test_accuracy"] > 0.5
+
+
+def test_model_arg_passthrough():
+    """--model-arg KEY=VALUE reaches the model constructor (a 3-layer
+    hidden-48 GPT has a distinct param tree)."""
+    import math
+
+    from distributed_tensorflow_tpu.cli import main, parse_model_args
+    from distributed_tensorflow_tpu.data.loaders import load_lm_dataset
+
+    assert parse_model_args(["hidden=48", "tie_embeddings=false",
+                             "positional=rope"]) == {
+        "hidden": 48, "tie_embeddings": False, "positional": "rope"}
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="KEY=VALUE"):
+        parse_model_args(["hidden"])
+
+    def lm_fn(batch_size, type="train", **kw):
+        return load_lm_dataset(seq_len=16, vocab_size=64, n_train=64,
+                               n_test=32, split=type)
+
+    summary = main(["-m", "t", "-n", "8", "-b", "4", "--model", "gpt",
+                    "--dataset", "lm_synth", "--model-arg", "hidden=48",
+                    "--model-arg", "layers=1", "--log-every", "0"],
+                   dataset_fn=lm_fn)
+    assert math.isfinite(summary["test_loss"])
+
+
+def test_model_arg_typo_fails_loudly():
+    """A typo'd --model-arg key must error, not silently train the
+    default-size model (the dtype-probe fallback once dropped all kwargs)."""
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    with pytest.raises(TypeError):
+        run(ExperimentConfig(engine="sync", model="gpt", dataset="lm_synth",
+                             n_devices=8, model_args={"hiden": 256}))
+
+
+def test_model_arg_rejected_under_pipeline():
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    with pytest.raises(ValueError, match="pipeline-hidden"):
+        run(ExperimentConfig(engine="sync", model="gpt", dataset="lm_synth",
+                             n_devices=8, pipeline_parallel=2,
+                             model_args={"hidden": 64}))
