@@ -262,18 +262,49 @@ def load_lm_dataset(
     name: str = "lm_synth",
     split: str = "train",
     seq_len: int = 128,
-    vocab_size: int = 128,
+    vocab_size: int | None = None,
     n_train: int = 4096,
     n_test: int = 1024,
+    holdout: float = 0.1,
 ) -> Dataset:
     """Language-modeling workload: (B, L) token inputs with (B, L)
     next-token targets (``num_classes`` = vocab size, so the engines' loss —
     which broadcasts over label dims, engines/base.py — trains it unchanged).
-    Synthetic-only, like the text loader: zero-egress environment."""
+
+    Real corpora: a local ``<name>.bin`` (or ``lm_tokens.bin``) in the data
+    search path — the standard flat binary of uint16 token ids (nanoGPT-
+    style) — is memory-mapped and windowed into non-overlapping seq_len
+    chunks with the final ``holdout`` fraction as the test split; the
+    window arrays are materialized (one contiguous read), so the engines
+    see plain numpy either way.  Pass ``vocab_size`` for large corpora —
+    when omitted it is derived with a full-file max scan (per split).
+    Otherwise the deterministic Markov-chain synthetic corpus (zero-egress
+    environment)."""
+    path = _find(f"{name}.bin", "lm_tokens.bin")
+    if path is not None:
+        tokens = np.memmap(path, dtype=np.uint16, mode="r")
+        cut = int(len(tokens) * (1.0 - holdout))
+        lo, hi = (0, cut) if split == "train" else (cut, len(tokens))
+        n = (hi - lo - 1) // seq_len
+        if n < 1:
+            # clamping to one window would read past the region (train
+            # would silently leak held-out tokens; test past EOF)
+            raise ValueError(
+                f"{split} region of {path.name} has {hi - lo} tokens — "
+                f"fewer than seq_len + 1 = {seq_len + 1}; shrink seq_len "
+                f"or holdout")
+        base = lo + np.arange(n * seq_len)
+        x = np.asarray(tokens[base]).reshape(n, seq_len).astype(np.int32)
+        y = np.asarray(tokens[base + 1]).reshape(n, seq_len).astype(np.int32)
+        vocab = (vocab_size if vocab_size is not None
+                 else int(tokens.max()) + 1)
+        return Dataset(x=x, y=y, num_classes=vocab, name=name,
+                       synthetic=False)
+    vocab = vocab_size if vocab_size is not None else 128
     n = n_train if split == "train" else n_test
-    x, y = synthetic_lm(n, seq_len=seq_len, vocab_size=vocab_size,
+    x, y = synthetic_lm(n, seq_len=seq_len, vocab_size=vocab,
                         seed=sum(ord(c) for c in name) % (2**31), split=split)
-    return Dataset(x=x, y=y, num_classes=vocab_size, name=name,
+    return Dataset(x=x, y=y, num_classes=vocab, name=name,
                    synthetic=True)
 
 

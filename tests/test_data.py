@@ -80,3 +80,48 @@ def test_iter_batches_padding_mask():
 def test_unknown_dataset():
     with pytest.raises(KeyError):
         load_dataset("imagenet")
+
+
+def test_lm_bin_corpus_loader(tmp_path, monkeypatch):
+    """A local <name>.bin (flat uint16 token ids) is memmapped and windowed
+    into next-token pairs; last 10% is the test split."""
+    from distributed_tensorflow_tpu.data.loaders import load_lm_dataset
+
+    tokens = (np.arange(1000) % 97).astype(np.uint16)
+    (tmp_path / "mycorpus.bin").write_bytes(tokens.tobytes())
+    monkeypatch.setenv("DTF_TPU_DATA_DIR", str(tmp_path))
+
+    tr = load_lm_dataset("mycorpus", split="train", seq_len=32)
+    te = load_lm_dataset("mycorpus", split="test", seq_len=32)
+    assert not tr.synthetic and not te.synthetic
+    assert tr.num_classes == 97
+    assert tr.x.shape == (28, 32)        # floor((900-1)/32) windows
+    assert te.x.shape[1] == 32
+    # next-token alignment inside every window
+    np.testing.assert_array_equal(tr.x[:, 1:], tr.y[:, :-1])
+    np.testing.assert_array_equal(
+        tr.x.reshape(-1)[1:], tr.y.reshape(-1)[:-1])
+    # splits come from disjoint regions of the stream
+    assert tr.x.max() <= 96 and te.x.min() >= 0
+    assert not np.array_equal(tr.x[: len(te.x)], te.x)
+
+    # absent file still falls back to the synthetic chain
+    missing = load_lm_dataset("nosuch", split="train", seq_len=16,
+                              vocab_size=32, n_train=64)
+    assert missing.synthetic and missing.num_classes == 32
+
+
+def test_lm_bin_corpus_too_small_region_rejected(tmp_path, monkeypatch):
+    """A split region smaller than one window must error, not read out of
+    bounds (test) or leak held-out tokens (train)."""
+    from distributed_tensorflow_tpu.data.loaders import load_lm_dataset
+
+    tokens = (np.arange(1000) % 50).astype(np.uint16)
+    (tmp_path / "small.bin").write_bytes(tokens.tobytes())
+    monkeypatch.setenv("DTF_TPU_DATA_DIR", str(tmp_path))
+    # test region = 100 tokens < 128 + 1
+    with pytest.raises(ValueError, match="seq_len"):
+        load_lm_dataset("small", split="test", seq_len=128)
+    # explicit vocab_size skips the full-file max scan and wins
+    tr = load_lm_dataset("small", split="train", seq_len=32, vocab_size=64)
+    assert tr.num_classes == 64
