@@ -38,6 +38,8 @@ import argparse
 import json
 import os
 import statistics
+import subprocess
+import sys
 import time
 from pathlib import Path
 
@@ -113,6 +115,79 @@ def _sync(tree) -> None:
     import jax
 
     np.asarray(jax.device_get(jax.tree.leaves(tree)[0]))
+
+
+# ---------------------------------------------------------------------------
+# backend acquisition guard (VERDICT r3 #1)
+#
+# Round 3's BENCH artifact was rc 1 / parsed null: the TPU lease was wedged
+# and ``jax.devices()`` raised (or hung) out of mesh.py:52, leaving the
+# driver a raw traceback instead of a JSON line.  The contract now matches
+# MULTICHIP's: on unrecoverable backend failure the bench emits ONE parsable
+# line ``{"metric": ..., "skipped": true, "error": ...}`` and exits 0 — a
+# recorded skip, not a crash.
+# ---------------------------------------------------------------------------
+
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "150"))
+                        # first TPU compile through the tunnel can take ~40s;
+                        # a wedged lease hangs forever — this bounds each try
+PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+PROBE_BACKOFF_S = int(os.environ.get("BENCH_PROBE_BACKOFF_S", "20"))
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp; "
+    "d = jax.devices(); "
+    "x = jnp.ones((8, 8)); "
+    "jnp.asarray((x @ x)).block_until_ready(); "
+    "print('BENCH_PROBE_OK', d[0].device_kind, len(d))"
+)
+
+
+def probe_backend() -> tuple[bool, str]:
+    """Check that the JAX backend can be acquired AND can execute, in a
+    throwaway subprocess so a hung ``jax.devices()`` (wedged tunnel lease)
+    cannot hang the bench itself.  Returns (ok, detail)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return False, f"backend probe hung >{PROBE_TIMEOUT_S}s (wedged lease?)"
+    out = (r.stdout or "") + (r.stderr or "")
+    if r.returncode == 0 and "BENCH_PROBE_OK" in out:
+        return True, out.strip().splitlines()[-1]
+    tail = "\n".join(out.strip().splitlines()[-6:])
+    return False, f"probe rc {r.returncode}: {tail}"
+
+
+def emit_skip(metric: str, error: str) -> None:
+    """The structured-failure line the driver records instead of a traceback."""
+    print(json.dumps({
+        "metric": metric,
+        "value": None,
+        "unit": None,
+        "vs_baseline": None,
+        "skipped": True,
+        "error": error[-2000:],
+    }))
+
+
+def ensure_backend(metric: str) -> None:
+    """Bounded retry-with-backoff around backend acquisition; on final
+    failure, emit the skip line and exit 0 (see module docstring)."""
+    detail = ""
+    for attempt in range(PROBE_RETRIES):
+        ok, detail = probe_backend()
+        if ok:
+            print(f"[bench] backend ok: {detail}", file=sys.stderr, flush=True)
+            return
+        print(f"[bench] backend probe {attempt + 1}/{PROBE_RETRIES} failed: "
+              f"{detail}", file=sys.stderr, flush=True)
+        if attempt + 1 < PROBE_RETRIES:
+            time.sleep(PROBE_BACKOFF_S * (attempt + 1))
+    emit_skip(metric, f"backend unavailable after {PROBE_RETRIES} probes: "
+              f"{detail}")
+    sys.exit(0)
 
 
 # ---------------------------------------------------------------------------
@@ -363,10 +438,16 @@ def bench_stream(steps: int = 100) -> None:
 
 def bench_attention(batch: int = 4, heads: int = 8, head_dim: int = 128,
                     seq_lens: tuple[int, ...] = (1024, 4096),
+                    dtypes: tuple[str, ...] = ("float32", "bfloat16"),
                     causal: bool = True) -> None:
-    """fwd+bwd step time of flash (ops/flash_attention.py) vs dense XLA
-    attention.  This is the measurement behind any speed claim the flash
-    kernel makes (VERDICT r2: 'measure it on the chip or delete the claim')."""
+    """fwd+bwd step time of flash (ops/flash_attention.py) vs dense (XLA)
+    attention, per (seq_len, dtype).  This is the measurement behind any
+    speed claim the flash kernel makes (VERDICT r2: 'measure it on the chip
+    or delete the claim'); the bf16 rows are the MXU-rate numbers that
+    matter at scale (VERDICT r3 #4 — the f32-only table under- or
+    over-sells the kernel depending on MXU behavior)."""
+    import itertools
+
     import jax
     import jax.numpy as jnp
 
@@ -375,13 +456,14 @@ def bench_attention(batch: int = 4, heads: int = 8, head_dim: int = 128,
 
     device_kind = jax.devices()[0].device_kind
     results = []
-    for L in seq_lens:
+    for L, dtype_name in itertools.product(seq_lens, dtypes):
+        dtype = jnp.dtype(dtype_name)
         key = jax.random.key(0)
         kq, kk, kv = jax.random.split(key, 3)
         shape = (batch, L, heads, head_dim)
-        q = jax.random.normal(kq, shape, jnp.float32)
-        k = jax.random.normal(kk, shape, jnp.float32)
-        v = jax.random.normal(kv, shape, jnp.float32)
+        q = jax.random.normal(kq, shape, jnp.float32).astype(dtype)
+        k = jax.random.normal(kk, shape, jnp.float32).astype(dtype)
+        v = jax.random.normal(kv, shape, jnp.float32).astype(dtype)
 
         def make_scan(attn, length):
             """fwd+bwd chained ``length`` times inside one jit: the next q
@@ -406,7 +488,7 @@ def bench_attention(batch: int = 4, heads: int = 8, head_dim: int = 128,
             "flash": lambda q_, k_, v_: flash_attention(
                 q_, k_, v_, causal=causal),
         }
-        row = {"seq_len": L}
+        row = {"seq_len": L, "dtype": dtype_name}
         K_UNIT = 100  # one compiled scan per impl; windows chain m calls
         for name, attn in impls.items():
             unit = make_scan(attn, K_UNIT)
@@ -440,7 +522,7 @@ def bench_attention(batch: int = 4, heads: int = 8, head_dim: int = 128,
     print(json.dumps({
         "metric": "attention_fwd_bwd_step_ms",
         "config": {"batch": batch, "heads": heads, "head_dim": head_dim,
-                   "causal": causal, "dtype": "float32"},
+                   "causal": causal, "dtypes": list(dtypes)},
         "device": device_kind,
         "rows": results,
     }))
@@ -559,6 +641,14 @@ def bench_lm(batch: int = 8, seq_len: int = 1024, vocab: int = 16384,
     }))
 
 
+_MODE_METRICS = {
+    "stream": "mnist_cnn_stream_examples_per_sec",
+    "attention": "attention_fwd_bwd_step_ms",
+    "lm": "gpt_lm_sync_tokens_per_sec_per_chip",
+    "default": "mnist_cnn_sync_examples_per_sec_per_chip",
+}
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--stream", action="store_true",
@@ -567,15 +657,30 @@ def main() -> None:
                    help="flash vs dense attention on-chip microbench")
     p.add_argument("--lm", action="store_true",
                    help="GPT decoder LM training throughput + MFU (bf16)")
+    p.add_argument("--no-probe", action="store_true",
+                   help="skip the backend-availability probe (saves ~10s "
+                        "when the backend is known-good)")
     args = p.parse_args()
-    if args.stream:
-        bench_stream()
-    elif args.attention:
-        bench_attention()
-    elif args.lm:
-        bench_lm()
-    else:
-        bench_throughput()
+    mode = ("stream" if args.stream else "attention" if args.attention
+            else "lm" if args.lm else "default")
+    metric = _MODE_METRICS[mode]
+    if not args.no_probe:
+        ensure_backend(metric)
+    try:
+        if mode == "stream":
+            bench_stream()
+        elif mode == "attention":
+            bench_attention()
+        elif mode == "lm":
+            bench_lm()
+        else:
+            bench_throughput()
+    except Exception as e:  # noqa: BLE001 — the artifact must stay parsable
+        import traceback
+        tb = traceback.format_exc()
+        print(tb, file=sys.stderr, flush=True)
+        emit_skip(metric, f"{type(e).__name__}: {e}\n{tb}")
+        sys.exit(0)
 
 
 if __name__ == "__main__":

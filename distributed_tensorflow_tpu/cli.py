@@ -225,11 +225,33 @@ def select_engine(args: argparse.Namespace) -> str:
     return "sync"  # tpu_pod
 
 
+def _honor_platform_env() -> None:
+    """Re-assert the user's JAX platform choice over preloaded plugins.
+
+    Environments that preload a PJRT plugin from sitecustomize (e.g. a
+    remote-TPU tunnel) may force ``jax_platforms`` via ``jax.config`` at
+    interpreter start, which silently overrides the ``JAX_PLATFORMS`` /
+    ``JAX_PLATFORM_NAME`` env vars the fake-CPU-mesh recipe uses (README:
+    testing multi-device flows without chips).  Re-apply the env choice
+    here — valid because no backend has been initialized yet when main()
+    starts.  Without this, a CPU-requested CLI run can hang trying to
+    initialize an unreachable accelerator backend."""
+    import os
+
+    want = (os.environ.get("JAX_PLATFORM_NAME")
+            or os.environ.get("JAX_PLATFORMS"))
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+
+
 def main(argv: list[str] | None = None, *, model_fn=None,
          dataset_fn=None) -> dict:
     """CLI entry.  ``model_fn``/``dataset_fn`` are the reference's user
     plug-in contract (reference README.md:12: "edit model_fn/dataset_fn in
     initializer.py"): when provided they override --model/--dataset."""
+    _honor_platform_env()
     parser = build_parser()
     args = parser.parse_args(argv)
 

@@ -298,6 +298,17 @@ def load_lm_dataset(
         y = np.asarray(tokens[base + 1]).reshape(n, seq_len).astype(np.int32)
         vocab = (vocab_size if vocab_size is not None
                  else int(tokens.max()) + 1)
+        if vocab_size is not None:
+            # an undersized explicit vocab would otherwise be silently
+            # clamped downstream (nn.Embed gather + CE label gather) and
+            # train on corrupted ids (ADVICE r3)
+            top = int(max(x.max(), y.max()))
+            if top >= vocab_size:
+                raise ValueError(
+                    f"vocab_size {vocab_size} does not cover {path.name}: "
+                    f"{split} split contains token id {top}; pass "
+                    f"vocab_size >= {top + 1} or omit it to derive from "
+                    f"the corpus")
         return Dataset(x=x, y=y, num_classes=vocab, name=name,
                        synthetic=False)
     vocab = vocab_size if vocab_size is not None else 128

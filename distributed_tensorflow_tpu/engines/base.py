@@ -78,6 +78,56 @@ def make_loss_fn(apply_fn: Callable) -> Callable:
     return loss_fn
 
 
+def gspmd_grad_accum(grad_fn, params, x, y, rng, K: int):
+    """K-microbatch gradient accumulation under GSPMD (global jit
+    semantics): reshape the batch to (K, B/K, ...), `lax.scan` the
+    microbatches, accumulate gradients, divide by K once.
+
+    ``grad_fn(params, xc, yc, rng_c) -> ((loss, acc), grads)`` — a
+    ``value_and_grad(..., has_aux=True)`` of a per-chunk mean loss.  The
+    returned gradient is then the global batch mean (mean of equal-chunk
+    means), identical math to K=1 — the GSPMD counterpart of the sync
+    engine's shard_map accumulation (engines/sync.py:68-111), but with no
+    manual psum: 'data' stays a GSPMD axis, so each chunk's gradient is
+    already globally reduced and the scan just sums K of them.  Activation
+    memory drops ~K× (one microbatch's activations live at a time);
+    gradient-accumulator memory is one extra param-sized buffer, sharded
+    like the params themselves.
+
+    Dropout draws an independent key per microbatch (fold_in on the chunk
+    index), matching K separate steps."""
+    if x.shape[0] % K:
+        raise ValueError(
+            f"global batch {x.shape[0]} not divisible by grad_accum {K}")
+    xm = x.reshape((K, x.shape[0] // K) + x.shape[1:])
+    ym = y.reshape((K, y.shape[0] // K) + y.shape[1:])
+
+    def micro(carry, chunk):
+        g_acc, l_acc, a_acc, i = carry
+        xc, yc = chunk
+        (l, a), g = grad_fn(params, xc, yc, jax.random.fold_in(rng, i))
+        return (jax.tree.map(jnp.add, g_acc, g),
+                l_acc + l, a_acc + a, i + 1), None
+
+    zero = jnp.zeros((), jnp.float32)
+    init = (jax.tree.map(jnp.zeros_like, params), zero, zero,
+            jnp.zeros((), jnp.int32))
+    (g_sum, l_sum, a_sum, _), _ = jax.lax.scan(micro, init, (xm, ym))
+    grads = jax.tree.map(lambda t: t / K, g_sum)
+    return grads, l_sum / K, a_sum / K
+
+
+def gspmd_value_and_grad(loss_fn, params, x, y, rng, K: int):
+    """(grads, loss, acc) of a GSPMD step — direct at K == 1, K-microbatch
+    accumulated otherwise.  The shared step core of the jit engines
+    (tensor_parallel, fsdp); ``loss_fn`` has the make_loss_fn signature."""
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    if K == 1:
+        (loss, acc), grads = grad_fn(params, x, y, rng)
+        return grads, loss, acc
+    return gspmd_grad_accum(grad_fn, params, x, y, rng, K)
+
+
 class Engine:
     """Base: owns model, optimizer, mesh; subclasses build the step program."""
 
@@ -223,10 +273,11 @@ class Engine:
         replicated-then-resharded).  Unannotated params replicate.
 
         ``spec_fn`` overrides the annotation-derived specs: it receives the
-        UNBOXED abstract state tree and returns a matching tree of
-        `PartitionSpec`s (the FSDP engine derives specs from leaf shapes
-        this way).  The resolved shardings are kept on
-        ``self._init_shardings`` for engines that pin step outputs.
+        UNBOXED abstract state tree AND the annotation-derived spec tree,
+        and returns a matching tree of `PartitionSpec`s (the FSDP engine
+        merges data-axis sharding into the annotations this way).  The
+        resolved shardings are kept on ``self._init_shardings`` for engines
+        that pin step outputs.
 
         The returned state is UNBOXED (plain arrays, no `nn.Partitioned`
         wrappers): the annotations' only runtime job is done once the arrays
@@ -258,7 +309,8 @@ class Engine:
         if spec_fn is None:
             specs = nn.get_partition_spec(abstract)
         else:
-            specs = spec_fn(nn.unbox(abstract))
+            specs = spec_fn(nn.unbox(abstract),
+                            nn.get_partition_spec(abstract))
         shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), specs,
             is_leaf=lambda s: isinstance(s, P))

@@ -30,72 +30,104 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_tpu.engines.base import (
-    Engine, TrainState, cross_entropy)
+    Engine, TrainState, gspmd_value_and_grad, make_loss_fn)
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
 
 def fsdp_spec(shape: tuple[int, ...], n: int,
-              axis: str = meshlib.DATA_AXIS) -> P:
-    """PartitionSpec sharding the largest ``n``-divisible dim over ``axis``.
+              axis: str = meshlib.DATA_AXIS,
+              base: P | None = None) -> P:
+    """PartitionSpec sharding the largest free ``n``-divisible dim over
+    ``axis``, on top of an optional ``base`` spec (the model's Megatron
+    annotations under fsdp×tp — dims the annotations already shard over
+    'model' are skipped, so each leaf ends up sharded over BOTH axes when
+    it has two eligible dims, or data-sharded on its largest free dim
+    otherwise).
 
-    Leaves with no divisible dimension (odd-sized biases, scalars, PRNG
-    keys) replicate — they are a negligible fraction of model bytes."""
+    Leaves with no divisible free dimension (odd-sized biases, scalars,
+    PRNG keys) keep ``base`` — they are a negligible fraction of model
+    bytes."""
+    spec: list = list(base) if base is not None else []
+    spec += [None] * (len(shape) - len(spec))
     best = None
     for i, d in enumerate(shape):
-        if d % n == 0 and d > 0 and (best is None or d > shape[best]):
+        if spec[i] is None and d % n == 0 and d > 0 and (
+                best is None or d > shape[best]):
             best = i
     if best is None:
-        return P()
-    spec: list[str | None] = [None] * len(shape)
+        return P(*spec) if any(s is not None for s in spec) else P()
     spec[best] = axis
     return P(*spec)
 
 
 class FSDPEngine(Engine):
-    """Fully-sharded sync data parallelism on a 1-D ('data',) mesh.
+    """Fully-sharded sync data parallelism on a ('data',) mesh — or
+    fsdp × tp on a ('data', 'model') mesh.
 
     Same step semantics as SyncEngine; different state layout: params and
     optimizer state are sharded over ``data`` (ZeRO-3), so per-device state
-    bytes shrink ~1/n while the training math stays bit-comparable."""
+    bytes shrink ~1/n while the training math stays bit-comparable.
 
-    def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3):
+    With a 'model' mesh axis, the model's Megatron ``with_partitioning``
+    annotations take their dims first (tensor parallelism — the compute
+    sharding) and the FSDP pass then shards each leaf's largest FREE dim
+    over 'data' (the storage sharding): a (in, hidden) TP kernel column-
+    sharded over 'model' additionally splits its 'in' dim over 'data',
+    giving per-device bytes ~1/(dp·tp).  XLA all-gathers the data dim
+    just-in-time per layer exactly as in plain FSDP; the 'model' dim stays
+    sharded through the compute (Megatron).
+
+    ``grad_accum`` K > 1 accumulates K microbatch gradients per optimizer
+    step (base.gspmd_grad_accum): identical math, ~K× less activation
+    memory — and the accumulator is itself FSDP-sharded.
+    """
+
+    def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
+                 grad_accum: int = 1):
+        if mesh is not None:
+            extra = set(mesh.axis_names) - {meshlib.DATA_AXIS,
+                                            meshlib.MODEL_AXIS}
+            if meshlib.DATA_AXIS not in mesh.axis_names or extra:
+                raise ValueError(
+                    f"FSDPEngine requires a ('data',) or ('data','model') "
+                    f"mesh, got axes {mesh.axis_names}")
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         super().__init__(model, optimizer, mesh, learning_rate)
+        self.grad_accum = grad_accum
+        self.tp_n = self.mesh.shape.get(meshlib.MODEL_AXIS, 1)
         self._state_shardings = None
 
     # ---------------------------------------------------------------- init
     def init_state(self, rng: jax.Array, sample_x) -> TrainState:
         """Materialize the state already sharded (never replicated first):
-        the base GSPMD init scaffolding with specs derived from leaf SHAPES
-        instead of model annotations (any model works unmodified)."""
+        the base GSPMD init scaffolding with specs derived from leaf shapes
+        (any model works unmodified), merged over the model's TP
+        annotations when the mesh carries a 'model' axis."""
         n = self.n_devices
         state = self._init_partitioned_state(
             rng, sample_x,
-            spec_fn=lambda abstract: jax.tree.map(
-                lambda leaf: fsdp_spec(leaf.shape, n), abstract))
+            spec_fn=lambda abstract, ann: jax.tree.map(
+                lambda leaf, spec: fsdp_spec(
+                    leaf.shape, n,
+                    base=spec if self.tp_n > 1 else None),
+                abstract, ann))
         self._state_shardings = self._init_shardings
         return state
 
     # ---------------------------------------------------------------- step
     def _build_step(self):
-        apply_fn = self.model.apply
-        tx = self.tx
+        loss_fn = make_loss_fn(self.model.apply)
+        tx, K = self.tx, self.grad_accum
 
         def train_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
-
-            def loss_fn(params):
-                logits = apply_fn({"params": params}, x, train=True,
-                                  rngs={"dropout": rng})
-                loss = cross_entropy(logits, y).mean()
-                acc = (logits.argmax(-1) == y).mean()
-                return loss, acc
-
             # jit semantics are global: `loss` is the global batch mean.
             # XLA all-gathers each param for its layer's compute and
             # reduce-scatters the grad back to the owning shard; the
             # optimizer update below then runs fully sharded (ZeRO).
-            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params)
+            grads, loss, acc = gspmd_value_and_grad(
+                loss_fn, state.params, x, y, rng, K)
             updates, opt_state = tx.update(grads, state.opt_state,
                                            state.params)
             params = optax.apply_updates(state.params, updates)

@@ -137,10 +137,12 @@ class CausalSelfAttention(nn.Module):
             # positions past it are NEG_INF'd, so no triangular mask needed.
             # CONTRACT: at most max_len tokens total.  The cursor is a
             # traced value, so overflow cannot raise here — past capacity,
-            # dynamic_update_slice clamps and the newest token silently
-            # overwrites slot max_len-1.  `generate` (the supported entry)
-            # checks prompt+max_new_tokens against max_len eagerly; direct
-            # decode-API users own the same bound.
+            # dynamic_update_slice clamps and the newest token overwrites
+            # slot max_len-1.  `generate` (the supported entry) checks
+            # prompt+max_new_tokens against max_len eagerly; direct
+            # decode-API users get a sticky ``cache['overflow']`` flag
+            # (ADVICE r3: the silent clamp corrupted continuations with no
+            # signal) — check it after the decode loop.
             if x.shape[1] != 1:
                 raise ValueError(
                     f"decode mode consumes one token per call, got "
@@ -161,10 +163,15 @@ class CausalSelfAttention(nn.Module):
                 (b, self.max_len, kvh, head_dim), self.dtype)
             cur = self.variable("cache", "cache_index",
                                 lambda: jnp.zeros((), jnp.int32))
+            ovf = self.variable("cache", "overflow",
+                                lambda: jnp.zeros((), jnp.bool_))
             if not ready:
                 out = dense_attention(q, widen(k), widen(v), causal=True)
             else:
                 i = cur.value
+                # sticky overflow marker: True once a token would land past
+                # capacity (dynamic_update_slice is about to clamp)
+                ovf.value = ovf.value | (i >= self.max_len)
                 ck.value = jax.lax.dynamic_update_slice(
                     ck.value, k, (0, i, 0, 0))
                 cv.value = jax.lax.dynamic_update_slice(

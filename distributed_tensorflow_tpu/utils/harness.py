@@ -139,23 +139,32 @@ def _setup(config: ExperimentConfig) -> _Experiment:
                          "expert_parallel")
              if getattr(config, f) > 1]
     if len(multi) > 1:
-        if set(multi) == {"seq_parallel", "tensor_parallel"}:
-            return _setup_composite(config)
-        if set(multi) == {"pipeline_parallel", "tensor_parallel"}:
-            return _setup_pipeline_tp(config)
-        if set(multi) == {"expert_parallel", "tensor_parallel"}:
-            return _setup_expert_tp(config)
-        if set(multi) == {"pipeline_parallel", "seq_parallel"}:
-            return _setup_pipeline_sp(config)
-        raise ValueError(
-            f"{' and '.join(multi)} cannot be combined; composable pairs in "
-            f"this release: tensor_parallel × seq_parallel (dp×tp×sp), "
-            f"pipeline_parallel × tensor_parallel (dp×pp×tp), "
-            f"expert_parallel × tensor_parallel (dp×ep×tp), and "
-            f"pipeline_parallel × seq_parallel (dp×pp×sp)")
+        combos = {
+            frozenset({"seq_parallel", "tensor_parallel"}): _setup_composite,
+            frozenset({"pipeline_parallel", "tensor_parallel"}):
+                _setup_pipeline_tp,
+            frozenset({"expert_parallel", "tensor_parallel"}): _setup_expert_tp,
+            frozenset({"pipeline_parallel", "seq_parallel"}):
+                _setup_pipeline_sp,
+            frozenset({"pipeline_parallel", "tensor_parallel",
+                       "seq_parallel"}): _setup_pipeline_tp_sp,
+        }
+        setup = combos.get(frozenset(multi))
+        if setup is None:
+            raise ValueError(
+                f"{' and '.join(multi)} cannot be combined; composable in "
+                f"this release: tensor_parallel × seq_parallel (dp×tp×sp), "
+                f"pipeline_parallel × tensor_parallel (dp×pp×tp), "
+                f"expert_parallel × tensor_parallel (dp×ep×tp), "
+                f"pipeline_parallel × seq_parallel (dp×pp×sp), and "
+                f"pipeline_parallel × tensor_parallel × seq_parallel "
+                f"(dp×pp×tp×sp, a 4-D mesh)")
+        return setup(config)
     if config.seq_parallel > 1:
         return _setup_seq_parallel(config)
     if config.tensor_parallel > 1:
+        if config.engine == "fsdp":
+            return _setup_fsdp_tp(config)
         return _setup_tensor_parallel(config)
     if config.pipeline_parallel > 1:
         return _setup_pipeline_parallel(config)
@@ -183,15 +192,15 @@ def _setup(config: ExperimentConfig) -> _Experiment:
     elif config.engine == "gossip":
         engine_kw["degree"] = config.degree
     if config.grad_accum > 1:
-        if config.engine not in ("sync", "allreduce"):
+        if config.engine not in ("sync", "allreduce", "fsdp"):
             raise ValueError(
-                f"grad_accum is implemented by the sync/allreduce engines "
-                f"(got engine='{config.engine}')")
+                f"grad_accum is implemented by the sync/allreduce/fsdp "
+                f"engines (got engine='{config.engine}')")
         if (global_batch // n) % config.grad_accum:
             raise ValueError(
                 f"per-device batch {global_batch // n} not divisible by "
                 f"grad_accum {config.grad_accum}")
-    if config.engine in ("sync", "allreduce"):
+    if config.engine in ("sync", "allreduce", "fsdp"):
         engine_kw["grad_accum"] = config.grad_accum
     engine = create_engine(config.engine, model, **engine_kw)
     return _Experiment(mesh=mesh, n=n, train_ds=train_ds, test_ds=test_ds,
@@ -299,13 +308,17 @@ def _resolve_model(config: ExperimentConfig, num_classes: int):
                 f"models; the model_fn owns its dtype", stacklevel=2)
         return config.model_fn()
     kw = dict(config.model_args or {})
-    kw.update(_lm_model_kw(config))
+    forced = _lm_model_kw(config)
     if config.model in ("moe", "moe_mlp"):
         # router_top_k is a MODEL knob — it applies under any engine (a
         # -ep 1 run still routes).  router_z_weight is an ENGINE knob that
         # only the expert-parallel engine consumes; reject it elsewhere
         # instead of silently ignoring it (checked in _setup)
-        kw["router_top_k"] = config.router_top_k
+        forced["router_top_k"] = config.router_top_k
+    _check_reserved_model_args(
+        config, {"num_classes", "dtype", *forced},
+        f"--model {config.model}")
+    kw.update(forced)
     if config.model in _SEQUENCE_MODELS and config.attention_impl in (
             "flash", "ring_flash"):
         # the Pallas kernel is valid without a seq axis (single-device
@@ -367,19 +380,28 @@ def _global_batch(config: ExperimentConfig, dp: int) -> int:
 
 
 def _split_mesh(config: ExperimentConfig, factor: int, factor_name: str,
-                second_axis: str, *more: tuple[int, str]):
+                second_axis: str, *more: tuple[int, str],
+                engines: tuple[str, ...] = ("sync", "allreduce"),
+                grad_accum_ok: bool = False):
     """(data, <second_axis>, ...) mesh: the named factors take their axes,
-    the remaining devices shard data.  Shared by every model-parallel setup."""
+    the remaining devices shard data.  Shared by every model-parallel setup.
+
+    ``engines`` names the engine semantics the mode supports (fsdp×tp passes
+    ('fsdp',)); ``grad_accum_ok`` marks modes whose engine implements
+    K-microbatch accumulation (the GSPMD engines — tp, fsdp)."""
     import jax as _jax
 
-    if config.engine not in ("sync", "allreduce"):
+    if config.engine not in engines:
         raise ValueError(
-            f"{factor_name} supports sync semantics only, got "
-            f"engine='{config.engine}'")
-    if config.grad_accum > 1:
+            f"{factor_name} supports {'/'.join(engines)} semantics only, "
+            f"got engine='{config.engine}'")
+    if config.grad_accum > 1 and not grad_accum_ok:
         raise ValueError(
-            "grad_accum is implemented by the sync/allreduce data-parallel "
-            "engines; it does not compose with model-parallel modes yet")
+            f"grad_accum composes with the sync/allreduce/fsdp data-parallel "
+            f"engines and with tensor_parallel (GSPMD accumulation), not "
+            f"with {factor_name}: the pipeline modes already microbatch "
+            f"(--microbatches), and the manual-axis modes (seq/expert) "
+            f"don't accumulate yet")
     factors = [(factor, second_axis), *more]
     total = config.n_devices or len(_jax.devices())
     prod = 1
@@ -424,26 +446,68 @@ def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
                        engine=engine, global_batch=_global_batch(config, dp))
 
 
+def _tp_model(config: ExperimentConfig, train_ds, mode: str):
+    """Model for the ('data','model')-mesh modes (tp, fsdp×tp): the
+    Megatron-annotated MLP for the reference's default model names, or a
+    TP-annotated sequence model."""
+    from distributed_tensorflow_tpu.engines.tensor_parallel import TPMLP
+
+    if config.model_fn is None and config.model in ("mlp", "tp_mlp",
+                                                    "mnist_mlp"):
+        return TPMLP(num_classes=train_ds.num_classes,
+                     dtype=modellib.resolve_dtype(config.dtype))
+    return _sequence_model(config, train_ds, mode,
+                           partition_model=True, attention_impl="dense")
+
+
+def _check_accum_divides(config: ExperimentConfig, global_batch: int,
+                         mode: str) -> None:
+    if config.grad_accum > 1 and global_batch % config.grad_accum:
+        raise ValueError(
+            f"{mode}: global batch {global_batch} not divisible by "
+            f"grad_accum {config.grad_accum}")
+
+
 def _setup_tensor_parallel(config: ExperimentConfig) -> _Experiment:
     """Megatron-style TP: 2-D (data, model) mesh, weights sharded by GSPMD."""
     from distributed_tensorflow_tpu.engines.tensor_parallel import (
-        TensorParallelEngine, TPMLP)
+        TensorParallelEngine)
 
     mesh, dp = _split_mesh(config, config.tensor_parallel, "tensor_parallel",
-                           meshlib.MODEL_AXIS)
+                           meshlib.MODEL_AXIS, grad_accum_ok=True)
     train_ds, test_ds = _load_data(config)
-    if config.model_fn is None and config.model in ("mlp", "tp_mlp",
-                                                    "mnist_mlp"):
-        model = TPMLP(num_classes=train_ds.num_classes,
-                      dtype=modellib.resolve_dtype(config.dtype))
-    else:
-        model = _sequence_model(config, train_ds, "tensor_parallel",
-                                partition_model=True, attention_impl="dense")
+    model = _tp_model(config, train_ds, "tensor_parallel")
+    _check_accum_divides(config, _global_batch(config, dp), "tensor_parallel")
 
     engine = TensorParallelEngine(
         model, mesh=mesh, learning_rate=config.learning_rate,
         optimizer=_make_optimizer(config, train_ds,
-                                  _global_batch(config, dp)))
+                                  _global_batch(config, dp)),
+        grad_accum=config.grad_accum)
+    return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
+                       engine=engine, global_batch=_global_batch(config, dp))
+
+
+def _setup_fsdp_tp(config: ExperimentConfig) -> _Experiment:
+    """fsdp × tp: ('data','model') mesh — the model's Megatron annotations
+    take their dims (compute sharding), then FSDP shards each leaf's
+    largest free dim over 'data' (storage sharding, engines/fsdp.py
+    fsdp_spec base=): per-device state bytes ~1/(dp·tp)."""
+    from distributed_tensorflow_tpu.engines.fsdp import FSDPEngine
+
+    mesh, dp = _split_mesh(config, config.tensor_parallel,
+                           "fsdp×tensor_parallel", meshlib.MODEL_AXIS,
+                           engines=("fsdp",), grad_accum_ok=True)
+    train_ds, test_ds = _load_data(config)
+    model = _tp_model(config, train_ds, "fsdp×tensor_parallel")
+    _check_accum_divides(config, _global_batch(config, dp),
+                         "fsdp×tensor_parallel")
+
+    engine = FSDPEngine(
+        model, mesh=mesh, learning_rate=config.learning_rate,
+        optimizer=_make_optimizer(config, train_ds,
+                                  _global_batch(config, dp)),
+        grad_accum=config.grad_accum)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
 
@@ -470,6 +534,9 @@ def _sequence_model(config: ExperimentConfig, train_ds, mode: str, **kw):
         return config.model_fn()
     if config.model in _SEQUENCE_MODELS:
         _require_token_data(train_ds, config, mode)
+        _check_reserved_model_args(
+            config, {"num_classes", "dtype", *kw, *_lm_model_kw(config)},
+            mode)
         kw = {**(config.model_args or {}), **kw}
         kw.update(_lm_model_kw(config))
         return modellib.create_model(
@@ -480,14 +547,50 @@ def _sequence_model(config: ExperimentConfig, train_ds, mode: str, **kw):
         f"--model {config.model}; pass model_fn for a custom model")
 
 
+def _check_reserved_model_args(config: ExperimentConfig, reserved,
+                               where: str) -> None:
+    """--model-arg keys that a dedicated flag or the mode itself sets would
+    otherwise surface as a raw ``got multiple values`` TypeError (or be
+    silently overridden) when splatted into create_model (ADVICE r3).
+    Reject them with the same clean style as the other CLI validations."""
+    bad = sorted(set(config.model_args or {}) & set(reserved))
+    if bad:
+        raise ValueError(
+            f"--model-arg key(s) {bad} are reserved for {where}: they are "
+            f"set by a dedicated flag or by the mode itself (e.g. "
+            f"--num-experts, --dtype, --kv-heads, --positional, "
+            f"--attention); drop them from --model-arg")
+
+
 def _reject_model_args(config: ExperimentConfig, mode: str) -> None:
-    """Pipeline stages are sized by --pipeline-hidden, not --model-arg —
-    reject rather than silently train a default-size model (same policy as
-    --router-z-weight outside EP)."""
+    """The built-in MLP pipeline stages are sized by --pipeline-hidden, not
+    --model-arg — reject rather than silently train a default-size model
+    (same policy as --router-z-weight outside EP).  The BERT/GPT stage
+    families DO take --model-arg (see _stage_model_args)."""
     if config.model_args:
         raise ValueError(
             f"--model-arg does not reach {mode} stage modules; size them "
             f"with --pipeline-hidden (got {sorted(config.model_args)})")
+
+
+_STAGE_MODEL_ARGS = ("heads", "ffn", "layers_per_stage")
+
+
+def _stage_model_args(config: ExperimentConfig, mode: str) -> dict:
+    """--model-arg keys the BERT/GPT pipeline-stage families accept
+    (VERDICT r3 #6: an 8-head or 2-layers-per-stage pipeline should not
+    require Python).  Width still comes from --pipeline-hidden; everything
+    else is either a dedicated flag (--kv-heads, --positional) or not a
+    per-stage knob — reject with the full picture."""
+    extra = dict(config.model_args or {})
+    bad = sorted(set(extra) - set(_STAGE_MODEL_ARGS))
+    if bad:
+        raise ValueError(
+            f"--model-arg key(s) {bad} do not reach {mode} stage modules; "
+            f"stages accept {'/'.join(_STAGE_MODEL_ARGS)} via --model-arg, "
+            f"width via --pipeline-hidden, and K/V heads / positional "
+            f"encoding via --kv-heads / --positional")
+    return extra
 
 
 def _pipeline_stages(config: ExperimentConfig, train_ds, test_ds, mode: str,
@@ -497,9 +600,11 @@ def _pipeline_stages(config: ExperimentConfig, train_ds, test_ds, mode: str,
     """(embed, block, head) for the pipeline setups, by model family:
     BERT encoder (models/bert.py) or GPT decoder LM (models/gpt.py).
     ``attention_impl``/``seq_axis`` make the GPT stages sequence-parallel
-    for dp×pp×sp."""
+    for dp×pp×sp.  ``--model-arg heads/ffn/layers_per_stage`` size the
+    stages (_stage_model_args)."""
     _require_token_data(train_ds, config, mode)
     dtype = modellib.resolve_dtype(config.dtype)
+    extra = _stage_model_args(config, mode)
     if config.model in _LM_MODELS:
         from distributed_tensorflow_tpu.models.gpt import gpt_pipeline_stages
 
@@ -512,7 +617,8 @@ def _pipeline_stages(config: ExperimentConfig, train_ds, test_ds, mode: str,
             kv_heads=config.kv_heads,
             attention_impl=attention_impl,
             seq_axis=seq_axis,
-            dtype=dtype)
+            dtype=dtype,
+            **extra)
     from distributed_tensorflow_tpu.models.bert import bert_pipeline_stages
 
     # vocab must cover BOTH splits: nn.Embed silently clamps out-of-range
@@ -523,7 +629,8 @@ def _pipeline_stages(config: ExperimentConfig, train_ds, test_ds, mode: str,
         hidden=config.pipeline_hidden,
         max_len=train_ds.x.shape[1],
         partition_model=partition_model,
-        dtype=dtype)
+        dtype=dtype,
+        **extra)
 
 
 def _setup_composite(config: ExperimentConfig) -> _Experiment:
@@ -551,7 +658,6 @@ def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
     over 'pipe'; --model picks the stage family — the built-in MLP stages or
     a BERT encoder split layer-per-stage (models/bert.py
     bert_pipeline_stages)."""
-    _reject_model_args(config, "pipeline_parallel")
     from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
 
     mesh, dp = _split_mesh(config, config.pipeline_parallel,
@@ -568,6 +674,9 @@ def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
             f"{'/'.join(_SEQUENCE_MODELS)} (got --model {config.model}); "
             f"custom models pass stages=(embed, block, head) to "
             f"PipelineEngine directly")
+    else:
+        # built-in MLP stages: sized by --pipeline-hidden only
+        _reject_model_args(config, "pipeline_parallel")
     if (_global_batch(config, dp) // dp) % config.microbatches:
         raise ValueError(
             f"per-data-shard batch {_global_batch(config, dp) // dp} not "
@@ -591,7 +700,6 @@ def _setup_pipeline_tp(config: ExperimentConfig) -> _Experiment:
     over (data, pipe), Megatron TP inside each stage as a GSPMD auto axis
     (engines/pipeline.py).  Sequence-model stages only (BERT encoder or GPT
     decoder): the built-in MLP stages carry no Megatron annotations."""
-    _reject_model_args(config, "pipeline_parallel×tensor_parallel")
     from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
 
     mesh, dp = _split_mesh(config, config.pipeline_parallel,
@@ -645,6 +753,9 @@ def _setup_expert_parallel(config: ExperimentConfig,
             raise ValueError(
                 f"num_experts {config.num_experts} not divisible by "
                 f"expert_parallel {config.expert_parallel}")
+        _check_reserved_model_args(
+            config, {"num_classes", "num_experts", "partition_experts",
+                     "partition_model", "router_top_k", "dtype"}, mode)
         model = modellib.create_model(
             "moe", num_classes=train_ds.num_classes,
             **(config.model_args or {}),
@@ -671,33 +782,40 @@ def _setup_expert_parallel(config: ExperimentConfig,
                        global_batch=_global_batch(config, n_token_shards))
 
 
-def _setup_pipeline_sp(config: ExperimentConfig) -> _Experiment:
+def _setup_pipeline_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
     """dp×pp×sp: 3-D (data, pipe, seq) mesh — GPipe schedule manual over
     (data, pipe), ring/Ulysses attention manual over 'seq' inside each
     stage (engines/pipeline.py).  GPT decoder stages only: a seq-sharded
     carry cannot serve a [CLS] classification head, and the LM's per-token
-    loss is what the schedule's drain reduces correctly."""
-    _reject_model_args(config, "pipeline_parallel×seq_parallel")
+    loss is what the schedule's drain reduces correctly.
+
+    ``tp > 1`` adds a 'model' GSPMD axis — dp×pp×tp×sp on a 4-D mesh: the
+    shard_map stays manual over (data, pipe, seq) while each stage's
+    Megatron annotations drive in-stage model-axis collectives (the same
+    partial-manual composition as pp×tp, engines/pipeline.py
+    _wrap_pipe_step)."""
     from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
 
+    mode = ("pipeline_parallel×tensor_parallel×seq_parallel" if tp > 1
+            else "pipeline_parallel×seq_parallel")
     if config.model not in _LM_MODELS or config.model_fn is not None:
         raise ValueError(
-            f"pipeline_parallel×seq_parallel ships GPT decoder stages only "
+            f"{mode} ships GPT decoder stages only "
             f"(got --model {config.model}); custom models pass seq-aware "
             f"stages to PipelineEngine directly")
     if config.attention_impl == "flash":
         raise ValueError(
             "--attention flash is the single-device kernel; with "
             "--seq-parallel use ring or ring_flash")
-    mesh, dp = _split_mesh(config, config.pipeline_parallel,
-                           "pipeline_parallel×seq_parallel",
+    extra = [(tp, meshlib.MODEL_AXIS)] if tp > 1 else []
+    mesh, dp = _split_mesh(config, config.pipeline_parallel, mode,
                            meshlib.PIPE_AXIS,
-                           (config.seq_parallel, meshlib.SEQ_AXIS))
+                           (config.seq_parallel, meshlib.SEQ_AXIS), *extra)
     train_ds, test_ds = _load_data(config)
-    stages = _pipeline_stages(config, train_ds, test_ds,
-                              "pipeline_parallel×seq_parallel",
+    stages = _pipeline_stages(config, train_ds, test_ds, mode,
                               attention_impl=config.attention_impl,
-                              seq_axis=meshlib.SEQ_AXIS)
+                              seq_axis=meshlib.SEQ_AXIS,
+                              partition_model=tp > 1)
     if (_global_batch(config, dp) // dp) % config.microbatches:
         raise ValueError(
             f"per-data-shard batch {_global_batch(config, dp) // dp} not "
@@ -710,6 +828,11 @@ def _setup_pipeline_sp(config: ExperimentConfig) -> _Experiment:
                             schedule=config.pipeline_schedule)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
+
+
+def _setup_pipeline_tp_sp(config: ExperimentConfig) -> _Experiment:
+    """dp×pp×tp×sp (4-D mesh) — see _setup_pipeline_sp(tp=...)."""
+    return _setup_pipeline_sp(config, tp=config.tensor_parallel)
 
 
 def _setup_expert_tp(config: ExperimentConfig) -> _Experiment:
@@ -810,8 +933,14 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         ev = trainer.evaluate(test_ds, batch_size=config.eval_batch)
         sink.results(ev["accuracy"], loss=ev["loss"])
 
-        if config.seq_parallel > 1 and config.tensor_parallel > 1:
+        if (config.pipeline_parallel > 1 and config.tensor_parallel > 1
+                and config.seq_parallel > 1):
+            engine_name = (f"pipeline_tp_sp[dp*pp*tp*sp,"
+                           f"{config.attention_impl}]")
+        elif config.seq_parallel > 1 and config.tensor_parallel > 1:
             engine_name = f"composite[dp*tp*sp,{config.attention_impl}]"
+        elif config.tensor_parallel > 1 and config.engine == "fsdp":
+            engine_name = "fsdp_tp[fsdp*tp]"
         elif config.pipeline_parallel > 1 and config.tensor_parallel > 1:
             engine_name = f"pipeline_tp[dp*pp*tp,{config.pipeline_schedule}]"
         elif config.expert_parallel > 1 and config.tensor_parallel > 1:

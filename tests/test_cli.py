@@ -47,6 +47,7 @@ def test_reference_flag_surface_accepted():
     ["-m", "c", "-cs", "async", "-n", "8", "-b", "8", "--sync-every", "4"],
     ["-m", "d", "-ds", "custom", "-n", "8", "-b", "8", "-d", "2"],
 ])
+@pytest.mark.slow
 def test_cli_end_to_end(tmp_path, capsys, argv):
     out = tmp_path / "events.jsonl"
     summary = main(argv + ["--dataset", "synthetic", "--model", "mlp",
@@ -175,6 +176,7 @@ def test_cli_user_plugin_model_and_dataset_fn():
     assert summary["test_accuracy"] > 0.5
 
 
+@pytest.mark.slow
 def test_model_arg_passthrough():
     """--model-arg KEY=VALUE reaches the model constructor (a 3-layer
     hidden-48 GPT has a distinct param tree)."""
@@ -220,3 +222,25 @@ def test_model_arg_rejected_under_pipeline():
         run(ExperimentConfig(engine="sync", model="gpt", dataset="lm_synth",
                              n_devices=8, pipeline_parallel=2,
                              model_args={"hidden": 64}))
+
+
+def test_model_arg_reserved_key_rejected_cleanly():
+    """--model-arg keys owned by dedicated flags (num_experts under EP,
+    dtype anywhere) must raise the clean reserved-key ValueError, not a raw
+    'got multiple values' TypeError (ADVICE r3)."""
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    with pytest.raises(ValueError, match="reserved"):
+        run(ExperimentConfig(engine="sync", model="moe",
+                             dataset="synthetic", n_devices=8,
+                             expert_parallel=4, num_experts=4,
+                             model_args={"num_experts": 8}))
+    with pytest.raises(ValueError, match="reserved"):
+        run(ExperimentConfig(engine="sync", model="gpt", dataset="lm_synth",
+                             n_devices=8, model_args={"dtype": "float16"}))
+    with pytest.raises(ValueError, match="reserved"):
+        run(ExperimentConfig(engine="sync", model="gpt",
+                             dataset="lm_synth", n_devices=8,
+                             seq_parallel=2,
+                             model_args={"attention_impl": "ulysses"}))

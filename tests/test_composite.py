@@ -38,6 +38,7 @@ def mesh3(dp=2, tp=2, sp=2):
                                axis_names=("data", "model", "seq"))
 
 
+@pytest.mark.slow
 def test_composite_matches_single_device(text_data):
     """(data=2, model=2, seq=2) ring+TP training must reproduce single-device
     dense-attention unsharded training step-for-step."""
@@ -62,6 +63,7 @@ def test_composite_matches_single_device(text_data):
     assert float(m1["loss"]) == pytest.approx(float(m8["loss"]), abs=1e-4)
 
 
+@pytest.mark.slow
 def test_composite_ulysses_matches_single_device(text_data):
     tr, _ = text_data
     x, y = tr.x[:16], tr.y[:16]
@@ -95,6 +97,7 @@ def test_composite_params_model_sharded(text_data):
     assert any("Embed_0" in n for n in sharded), sharded  # vocab embedding
 
 
+@pytest.mark.slow
 def test_composite_converges_and_evaluates(text_data):
     tr, te = text_data
     eng = CompositeEngine(tiny_bert("ring"), mesh=mesh3(),
@@ -106,6 +109,7 @@ def test_composite_converges_and_evaluates(text_data):
     assert ev["accuracy"] > 0.85, ev
 
 
+@pytest.mark.slow
 def test_composite_harness_run(tmp_path):
     """End-to-end: harness composes tensor_parallel × seq_parallel."""
     from distributed_tensorflow_tpu.utils.harness import ExperimentConfig, run

@@ -125,3 +125,19 @@ def test_lm_bin_corpus_too_small_region_rejected(tmp_path, monkeypatch):
     # explicit vocab_size skips the full-file max scan and wins
     tr = load_lm_dataset("small", split="train", seq_len=32, vocab_size=64)
     assert tr.num_classes == 64
+
+
+def test_lm_bin_explicit_vocab_undercoverage_rejected(tmp_path, monkeypatch):
+    """An explicit vocab_size smaller than the corpus's max token id must
+    raise (naming the offending id), not silently clamp in nn.Embed and the
+    CE label gather (ADVICE r3)."""
+    from distributed_tensorflow_tpu.data.loaders import load_lm_dataset
+
+    tokens = (np.arange(1000) % 97).astype(np.uint16)
+    (tmp_path / "wide.bin").write_bytes(tokens.tobytes())
+    monkeypatch.setenv("DTF_TPU_DATA_DIR", str(tmp_path))
+    with pytest.raises(ValueError, match="96"):
+        load_lm_dataset("wide", split="train", seq_len=32, vocab_size=50)
+    # a covering explicit vocab still wins over the derived one
+    tr = load_lm_dataset("wide", split="train", seq_len=32, vocab_size=128)
+    assert tr.num_classes == 128

@@ -125,6 +125,7 @@ def test_expert_parallel_rejects_wrong_mesh(mesh8):
         ExpertParallelEngine(model, mesh=mesh8)
 
 
+@pytest.mark.slow
 def test_harness_expert_parallel_cli():
     from distributed_tensorflow_tpu.cli import main
 
@@ -249,6 +250,7 @@ def test_moe_partition_model_requires_experts():
         layer.init(jax.random.key(0), x)
 
 
+@pytest.mark.slow
 def test_harness_expert_tp_cli():
     from distributed_tensorflow_tpu.cli import main
 

@@ -146,6 +146,7 @@ def test_harness_run_dispatches_recovery():
         harness.run(cfg)
 
 
+@pytest.mark.slow
 def test_recovery_end_to_end_resumes_from_checkpoint(tmp_path):
     """Crash mid-training → run_with_recovery resumes from the checkpoint
     and the final step count continues (not restarts) the original run."""

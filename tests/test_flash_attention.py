@@ -85,6 +85,7 @@ def test_gradients_match_dense(causal):
                                    err_msg=f"d{name} mismatch")
 
 
+@pytest.mark.slow
 def test_gradients_with_mask_and_padding():
     q, k, v = _qkv(jax.random.key(7), 1, 40, 2, 8)
     mask = jnp.ones((1, 40)).at[:, 33:].set(0.0)

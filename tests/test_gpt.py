@@ -51,6 +51,7 @@ def test_lm_synth_dataset(lm_data):
 # ------------------------------------------------- causal impl parity
 
 
+@pytest.mark.slow
 def test_flash_causal_matches_dense(lm_data):
     """Same params, same tokens: the Pallas flash path (interpret mode on
     CPU) must produce the dense-causal logits."""
@@ -133,6 +134,7 @@ def test_gpt_tensor_parallel_matches_single_device(lm_data):
 # ----------------------------------------------- sequence parallelism (LM)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
 def test_gpt_seq_parallel_matches_single_device(lm_data, impl):
     """Causal LM under (data=2, seq=4): per-token logits VARY over 'seq'
@@ -176,6 +178,7 @@ def test_gpt_seq_parallel_eval_counts_tokens(lm_data):
     assert ev["count"] == len(te) * te.x.shape[1]
 
 
+@pytest.mark.slow
 def test_gpt_composite_tp_sp_matches_single_device(lm_data):
     """dp×tp×sp GPT: Megatron-sharded weights (GSPMD) + manual-seq causal
     ring, LM loss varying over 'seq' — must reproduce single-device dense
@@ -213,6 +216,7 @@ def test_gpt_composite_tp_sp_matches_single_device(lm_data):
 # ---------------------------------------------------------------- pipeline
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_trains(lm_data):
     """GPT decoder over the pipe axis (embed → blocks → untied head)."""
     from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
@@ -239,6 +243,7 @@ def test_gpt_pipeline_trains(lm_data):
 # --------------------------------------------------------------- generate
 
 
+@pytest.mark.slow
 def test_generate_greedy_matches_full_forward(lm_data):
     """KV-cache decode oracle: greedy generation must reproduce the
     teacher-forced rollout that re-runs the FULL forward each step — any
@@ -286,6 +291,7 @@ def _lm_dataset_fn(batch_size, type="train", **kw):
                            split=type)
 
 
+@pytest.mark.slow
 def test_gpt_harness_dp(lm_data):
     from distributed_tensorflow_tpu.utils.harness import (
         ExperimentConfig, run)
@@ -297,6 +303,7 @@ def test_gpt_harness_dp(lm_data):
     assert np.isfinite(summary["test_loss"])
 
 
+@pytest.mark.slow
 def test_gpt_harness_seq_parallel():
     from distributed_tensorflow_tpu.utils.harness import (
         ExperimentConfig, run)
@@ -321,6 +328,7 @@ def test_gpt_rejects_non_token_dataset():
 # -------------------------------------------------------------------- RoPE
 
 
+@pytest.mark.slow
 def test_rope_gpt_trains_and_beats_chance(lm_data):
     tr, te = lm_data
     model = create_model("gpt", num_classes=64, hidden=32, layers=1,
@@ -335,6 +343,7 @@ def test_rope_gpt_trains_and_beats_chance(lm_data):
     assert t.evaluate(te, batch_size=64)["accuracy"] > 0.05
 
 
+@pytest.mark.slow
 def test_rope_seq_parallel_matches_single_device(lm_data):
     """RoPE under (data=2, seq=4) ring attention: each seq device must
     rotate its block at GLOBAL positions (offset = block index × local
@@ -371,6 +380,7 @@ def test_rope_seq_parallel_matches_single_device(lm_data):
     assert float(m1["loss"]) == pytest.approx(float(m8["loss"]), abs=1e-4)
 
 
+@pytest.mark.slow
 def test_rope_generate_matches_full_forward(lm_data):
     """KV-cache decode with RoPE: cached keys carry their own rotation;
     the cursor position rotates each new q — greedy generation must still
@@ -393,6 +403,7 @@ def test_rope_generate_matches_full_forward(lm_data):
     np.testing.assert_array_equal(out, cur[:, 8:])
 
 
+@pytest.mark.slow
 def test_rope_pipeline_trains(lm_data):
     """RoPE threads through the pipeline stages (no position table in any
     stage's params; blocks rotate at arange(L))."""
@@ -445,6 +456,7 @@ def test_gqa_param_shapes_and_training(lm_data):
 
 
 @pytest.mark.parametrize("kvh", [1, 2])
+@pytest.mark.slow
 def test_gqa_generate_matches_full_forward(lm_data, kvh):
     """MQA/GQA decode: the cache holds kv_heads only; greedy generation
     must still equal the teacher-forced full-forward rollout."""
@@ -477,6 +489,7 @@ def test_gqa_invalid_heads_rejected(lm_data):
 # ---------------------------------------------------------- checkpointing
 
 
+@pytest.mark.slow
 def test_gpt_checkpoint_roundtrip_and_generate(tmp_path, lm_data):
     """Orbax save → restore of a trained LM state, then generation parity:
     the restored params must produce byte-identical greedy continuations."""
@@ -510,6 +523,7 @@ def test_gpt_checkpoint_roundtrip_and_generate(tmp_path, lm_data):
     np.testing.assert_array_equal(out0, out1)
 
 
+@pytest.mark.slow
 def test_lm_summary_reports_perplexity():
     from distributed_tensorflow_tpu.utils.harness import (
         ExperimentConfig, run)
@@ -524,6 +538,7 @@ def test_lm_summary_reports_perplexity():
 # ------------------------------------------------- engine-matrix breadth
 
 
+@pytest.mark.slow
 def test_gpt_bf16_trains_finite(lm_data):
     """Mixed precision (bf16 activations, f32 params) on the LM: loss
     stays finite and decreases."""
@@ -546,6 +561,7 @@ def test_gpt_bf16_trains_finite(lm_data):
 
 
 @pytest.mark.parametrize("engine_name", ["async", "gossip"])
+@pytest.mark.slow
 def test_gpt_under_async_and_gossip(lm_data, engine_name):
     """The LM trains under the reference-parity DP engines too (local-SGD
     async, ppermute gossip) — (B, L) labels need no engine special-casing."""
@@ -561,3 +577,33 @@ def test_gpt_under_async_and_gossip(lm_data, engine_name):
     ev = t.evaluate(te, batch_size=64)
     assert np.isfinite(ev["loss"])
     assert ev["accuracy"] > 0.03  # above the 1/64 floor
+
+
+@pytest.mark.slow
+def test_decode_cache_overflow_flag():
+    """Direct decode-API use past max_len cannot raise (the cursor is
+    traced) but must not stay silent: the sticky cache['overflow'] flag
+    flips once a token would land past capacity (ADVICE r3)."""
+    import jax.numpy as jnp
+
+    def overflowed(cache):
+        leaves = [leaf for path, leaf
+                  in jax.tree_util.tree_flatten_with_path(cache)[0]
+                  if "overflow" in jax.tree_util.keystr(path)]
+        assert leaves, "decode cache carries no overflow flag"
+        return any(bool(x) for x in leaves)
+
+    model = tiny_gpt(max_len=4).clone(decode=True)
+    tok = np.zeros((1, 1), np.int32)
+    variables = model.init(jax.random.key(0), jnp.asarray(tok), train=False)
+    params, cache = variables["params"], variables["cache"]
+
+    flags = []
+    for _ in range(6):
+        _, upd = model.apply({"params": params, "cache": cache},
+                             jnp.asarray(tok), train=False,
+                             mutable=["cache"])
+        cache = upd["cache"]
+        flags.append(overflowed(cache))
+    # within capacity: clean; past it: sticky True
+    assert flags == [False, False, False, False, True, True]

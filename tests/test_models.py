@@ -13,6 +13,7 @@ from distributed_tensorflow_tpu.models import create_model, get_model_fn
     ("cnn", (2, 28, 28, 1)),
     ("cnn", (2, 28, 28)),   # no-channel input path
 ])
+@pytest.mark.slow
 def test_forward_shapes(name, shape):
     model = create_model(name, num_classes=10)
     x = jnp.ones(shape)
@@ -48,6 +49,7 @@ def test_unknown_model():
         create_model("transformer_xxl")
 
 
+@pytest.mark.slow
 def test_resnet20_forward():
     model = create_model("resnet20", num_classes=10)
     x = jnp.ones((2, 32, 32, 3))
@@ -56,6 +58,7 @@ def test_resnet20_forward():
     assert logits.shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_bert_tiny_forward():
     model = create_model("bert_tiny", num_classes=2, vocab_size=100, max_len=32)
     ids = jnp.array(np.random.default_rng(0).integers(1, 100, (2, 16)))
@@ -108,6 +111,7 @@ def test_bf16_training_learns(mesh8):
     assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(state.params))
 
 
+@pytest.mark.slow
 def test_bert_flash_matches_dense():
     """attention_impl='flash' (Pallas kernel) must agree with 'dense'."""
     kw = dict(num_classes=2, vocab_size=100, max_len=32)

@@ -60,12 +60,18 @@ def test_grad_accum_indivisible_batch_rejected(mnist):
         eng.step(s, xs, ys)
 
 
-def test_grad_accum_requires_dp_engine():
+def test_grad_accum_engine_support():
+    """grad_accum composes with the sync/allreduce/fsdp engines and with
+    tensor_parallel (GSPMD accumulation, round 4); the async/gossip engines
+    and the manual-axis modes (seq, expert) still reject it loudly."""
     with pytest.raises(ValueError, match="grad_accum"):
-        run(ExperimentConfig(engine="fsdp", grad_accum=2, n_devices=8))
+        run(ExperimentConfig(engine="async", grad_accum=2, n_devices=8))
     with pytest.raises(ValueError, match="grad_accum"):
         run(ExperimentConfig(model="bert_tiny", dataset="glue_synth",
-                             tensor_parallel=4, grad_accum=2, n_devices=8))
+                             seq_parallel=4, grad_accum=2, n_devices=8))
+    with pytest.raises(ValueError, match="grad_accum"):
+        run(ExperimentConfig(model="moe", expert_parallel=4, grad_accum=2,
+                             n_devices=8))
 
 
 # ------------------------------------------------------------ LR schedules

@@ -29,6 +29,7 @@ def _batch(n=16, seed=0):
     return x, y
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 @pytest.mark.parametrize("dp,pp,m", [(2, 4, 4), (1, 8, 2), (4, 2, 1)])
 def test_loss_matches_sequential_forward(dp, pp, m, schedule):
@@ -47,6 +48,7 @@ def test_loss_matches_sequential_forward(dp, pp, m, schedule):
 
 
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.slow
 def test_gradients_match_sequential_model(schedule):
     """One SGD step through the pipeline == explicit jax.grad of the
     sequential forward (microbatching must not change the math; for 1f1b
@@ -86,6 +88,7 @@ def test_params_stay_sharded_over_pipe():
     assert state.params["head"]["Dense_0"]["kernel"].sharding.is_fully_replicated
 
 
+@pytest.mark.slow
 def test_training_reduces_loss():
     mesh = _mesh(2, 2)
     eng = PipelineEngine(num_classes=4, hidden=32, microbatches=2, mesh=mesh,
@@ -163,6 +166,7 @@ def _tokens(n=16, seed=0):
     return x, y
 
 
+@pytest.mark.slow
 def test_bert_pipeline_matches_sequential_forward():
     """Pipelined BERT step loss == sequential-forward loss (VERDICT r1 #5:
     pipelining a real registered model, not the built-in MLP)."""
@@ -177,6 +181,7 @@ def test_bert_pipeline_matches_sequential_forward():
 
 
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.slow
 def test_bert_pipeline_gradients_match_sequential_model(schedule):
     lr = 0.1
     eng = _bert_engine(lr=lr, schedule=schedule)
@@ -197,6 +202,7 @@ def test_bert_pipeline_gradients_match_sequential_model(schedule):
         after, expected)
 
 
+@pytest.mark.slow
 def test_bert_pipeline_harness_run():
     """`-pp 4 --model bert_tiny` accepted end-to-end by the harness."""
     from distributed_tensorflow_tpu.data.loaders import load_text_dataset
@@ -225,6 +231,7 @@ def _mesh3(dp, pp, tp):
 
 
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.slow
 def test_bert_pipeline_tp_matches_sequential(schedule):
     """dp×pp×tp: the pipeline schedule manual over (data, pipe) with
     Megatron TP as a GSPMD auto axis inside each stage must still equal the
@@ -263,6 +270,7 @@ def test_bert_pipeline_tp_matches_sequential(schedule):
         after, expected)
 
 
+@pytest.mark.slow
 def test_pipeline_tp_harness_run():
     """`-pp 2 -tp 2 --model bert_tiny` accepted end-to-end by the harness."""
     from distributed_tensorflow_tpu.data.loaders import load_text_dataset
@@ -317,6 +325,7 @@ def _lm_tokens(n=8, seed=0):
 
 @pytest.mark.parametrize("impl,posn", [("ring", "learned"),
                                        ("ring_flash", "rope")])
+@pytest.mark.slow
 def test_pipeline_seq_parallel_matches_sequential(impl, posn):
     """dp×pp×sp GPT decoder: pipelined + seq-sharded training must equal
     the un-pipelined full-sequence oracle exactly (loss and one SGD step) —
@@ -356,6 +365,7 @@ def test_pipeline_seq_parallel_rejects_1f1b():
                                        seq_axis="seq"))
 
 
+@pytest.mark.slow
 def test_pipeline_seq_parallel_harness():
     from distributed_tensorflow_tpu.data.loaders import load_lm_dataset
     from distributed_tensorflow_tpu.utils.harness import (
@@ -371,3 +381,109 @@ def test_pipeline_seq_parallel_harness():
         epochs=1, log_every=0, dataset_fn=lm_fn))
     assert summary["engine"] == "pipeline_sp[dp*pp*sp,ring]"
     assert np.isfinite(summary["test_loss"])
+
+
+# ------------------------------------------------- dp x pp x tp x sp (4-D)
+
+
+def _pp_tp_sp_mesh():
+    return meshlib.create_mesh(
+        8, shape=(1, 2, 2, 2),
+        axis_names=(meshlib.DATA_AXIS, meshlib.PIPE_AXIS,
+                    meshlib.MODEL_AXIS, meshlib.SEQ_AXIS))
+
+
+@pytest.mark.slow
+def test_pipeline_tp_sp_matches_sequential():
+    """dp×pp×tp×sp on a 4-D mesh: the pipe schedule (manual), in-stage ring
+    attention (manual seq), AND Megatron TP (GSPMD auto axis) must together
+    reproduce the un-pipelined dense full-sequence oracle — loss and one
+    SGD step."""
+    from distributed_tensorflow_tpu.models.gpt import gpt_pipeline_stages
+
+    lr = 0.1
+    eng = PipelineEngine(
+        microbatches=2, mesh=_pp_tp_sp_mesh(), optimizer=optax.sgd(lr),
+        stages=gpt_pipeline_stages(vocab_size=64, hidden=32, heads=2,
+                                   ffn=64, max_len=16, partition_model=True,
+                                   attention_impl="ring", seq_axis="seq"))
+    rnd = np.random.default_rng(11)
+    x = rnd.integers(0, 64, (8, 16)).astype(np.int32)
+    y = np.roll(x, -1, axis=1).astype(np.int32)
+    state = eng.init_state(jax.random.key(0), x)
+    before = jax.device_get(state.params)
+    state, m = eng.step(state, *eng.shard_batch(x, y))
+    after = jax.device_get(state.params)
+
+    def ref_loss(params):
+        logits = eng._sequential_logits(params, x)
+        return cross_entropy(logits, jnp.asarray(y)).mean()
+
+    assert abs(float(m["loss"]) - float(ref_loss(before))) < 1e-5
+    grads = jax.grad(ref_loss)(before)
+    expected = jax.tree.map(lambda p, g: p - lr * g, before, grads)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(a, e, atol=2e-5, rtol=1e-4),
+        after, expected)
+
+
+@pytest.mark.slow
+def test_pipeline_tp_sp_harness():
+    from distributed_tensorflow_tpu.data.loaders import load_lm_dataset
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    def lm_fn(batch_size, type="train", **kw):
+        return load_lm_dataset(seq_len=16, vocab_size=64, n_train=128,
+                               n_test=64, split=type)
+
+    summary = run(ExperimentConfig(
+        engine="sync", model="gpt", dataset="lm_synth", n_devices=8,
+        pipeline_parallel=2, tensor_parallel=2, seq_parallel=2,
+        microbatches=2, batch_size=8, epochs=1, log_every=0,
+        pipeline_hidden=32, dataset_fn=lm_fn))
+    assert summary["engine"] == "pipeline_tp_sp[dp*pp*tp*sp,ring]"
+    assert np.isfinite(summary["test_loss"])
+
+
+# ------------------------------------------------- --model-arg stage sizing
+
+
+@pytest.mark.slow
+def test_stage_model_args_size_the_stages():
+    """--model-arg heads/ffn/layers_per_stage must reach the GPT/BERT stage
+    factories (VERDICT r3 #6): layers_per_stage=2 doubles each stage's
+    depth, visible in the stacked block param tree."""
+    from distributed_tensorflow_tpu.data.loaders import load_lm_dataset
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, _setup)
+
+    def lm_fn(batch_size, type="train", **kw):
+        return load_lm_dataset(seq_len=16, vocab_size=64, n_train=64,
+                               n_test=32, split=type)
+
+    ex = _setup(ExperimentConfig(
+        engine="sync", model="gpt", dataset="lm_synth", n_devices=8,
+        pipeline_parallel=2, microbatches=2, batch_size=8, log_every=0,
+        pipeline_hidden=32, dataset_fn=lm_fn,
+        model_args={"heads": 4, "ffn": 48, "layers_per_stage": 2}))
+    assert ex.engine.block.layers_per_stage == 2
+    assert ex.engine.block.heads == 4
+    assert ex.engine.block.ffn == 48
+    # and it actually trains
+    x = ex.train_ds.x[:8]
+    y = ex.train_ds.y[:8]
+    st = ex.engine.init_state(jax.random.key(0), x)
+    st, m = ex.engine.step(st, *ex.engine.shard_batch(x, y))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_stage_model_args_unknown_key_rejected():
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    with pytest.raises(ValueError, match="layers_per_stage"):
+        run(ExperimentConfig(
+            engine="sync", model="gpt", dataset="lm_synth", n_devices=8,
+            pipeline_parallel=2, microbatches=2, batch_size=8, epochs=1,
+            log_every=0, model_args={"hidden": 64}))

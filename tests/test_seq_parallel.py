@@ -31,6 +31,7 @@ def seq_mesh(dp, sp):
                                axis_names=("data", "seq"))
 
 
+@pytest.mark.slow
 def test_seq_parallel_matches_single_device(text_data):
     """(data=2, seq=4) ring-attention training must reproduce single-device
     dense-attention training step-for-step (same global batch, no dropout).
@@ -66,6 +67,7 @@ def test_seq_parallel_matches_single_device(text_data):
     assert float(m1["loss"]) == pytest.approx(float(m8["loss"]), abs=1e-4)
 
 
+@pytest.mark.slow
 def test_seq_parallel_ulysses_matches_single_device(text_data):
     import optax
 
@@ -90,6 +92,7 @@ def test_seq_parallel_ulysses_matches_single_device(text_data):
     assert float(m1["loss"]) == pytest.approx(float(m8["loss"]), abs=1e-4)
 
 
+@pytest.mark.slow
 def test_bert_ring_converges(text_data):
     tr, te = text_data
     eng = SeqParallelEngine(tiny_bert("ring"), mesh=seq_mesh(2, 4),
@@ -100,6 +103,7 @@ def test_bert_ring_converges(text_data):
     assert acc > 0.85, acc
 
 
+@pytest.mark.slow
 def test_seq_parallel_eval_full_test_set(text_data):
     _, te = text_data
     eng = SeqParallelEngine(tiny_bert("ring"), mesh=seq_mesh(2, 4))
@@ -115,6 +119,7 @@ def test_mesh_axis_validation():
         SeqParallelEngine(tiny_bert(), mesh=None)
 
 
+@pytest.mark.slow
 def test_seq_parallel_ring_flash_matches_single_device(text_data):
     """ring_flash (ring schedule + flash local math, VERDICT r2 task 5)
     must reproduce single-device dense training like plain ring does —
@@ -144,6 +149,7 @@ def test_seq_parallel_ring_flash_matches_single_device(text_data):
     assert float(m1["loss"]) == pytest.approx(float(m8["loss"]), abs=1e-4)
 
 
+@pytest.mark.slow
 def test_flash_attention_via_harness_dp_path(text_data):
     """--attention flash at seq_parallel == 1 (VERDICT r2 task 2: the CLI
     must be able to reach the Pallas kernel end-to-end)."""
