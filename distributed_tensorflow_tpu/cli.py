@@ -140,6 +140,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GPT grouped-query attention: K/V head count "
                         "(< --heads; 1 = multi-query).  Shrinks the decode "
                         "KV cache by heads/kv_heads")
+    p.add_argument("--remat", action="store_true",
+                   help="activation checkpointing: store each transformer "
+                        "block's input only, recompute the block in "
+                        "backward (~K x less activation memory for ~1/3 "
+                        "more FLOPs; also bounds the GPipe tick stash). "
+                        "The long-context memory lever")
     p.add_argument("--model-arg", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="extra model constructor field (repeatable), e.g. "
@@ -306,6 +312,7 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         attention_impl=args.attention,
         positional=args.positional,
         kv_heads=args.kv_heads,
+        remat=args.remat,
         model_args=model_args,
         tensor_parallel=args.tensor_parallel,
         pipeline_parallel=args.pipeline_parallel,

@@ -12,10 +12,16 @@ auxiliary loss + ``router_z_weight`` × the router z-loss, both sown by the
 model into ``intermediates`` — without the balance loss top-1 routing
 collapses onto a few experts.  The per-step metrics carry ``overflow``
 (fraction of routing assignments dropped at capacity), so router collapse
-is observable directly instead of as silent accuracy loss.
+is observable directly instead of as silent accuracy loss — and sustained
+high overflow additionally WARNS (an _OverflowMonitor watches a rolling
+window; VERDICT r3 #10: a collapsed router must be loud, not a metric
+someone has to be watching).
 """
 
 from __future__ import annotations
+
+import collections
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +31,53 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distributed_tensorflow_tpu.engines.base import (
     Engine, TrainState, cross_entropy)
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+
+class _OverflowMonitor:
+    """Rolling watch over the per-step expert-overflow fraction.
+
+    Buffers the (device-scalar) metric and evaluates the window mean every
+    ``window`` observations — fetching only at that cadence keeps the step
+    loop's async dispatch intact.  When the mean exceeds ``threshold`` it
+    emits ONE ``warnings.warn`` per sustained-high episode (re-arming after
+    the mean drops back under), and keeps totals for the run summary."""
+
+    def __init__(self, threshold: float = 0.25, window: int = 50):
+        self.threshold = threshold
+        self.window = max(int(window), 1)
+        self._buf: collections.deque = collections.deque(maxlen=self.window)
+        self._count = 0
+        self._armed = True
+        self.last_window_mean: float | None = None
+        self.warning_count = 0
+
+    def observe(self, overflow) -> None:
+        self._buf.append(overflow)
+        self._count += 1
+        if self._count % self.window:
+            return
+        mean = float(sum(float(v) for v in self._buf) / len(self._buf))
+        self.last_window_mean = mean
+        if mean > self.threshold:
+            if self._armed:
+                self._armed = False
+                self.warning_count += 1
+                warnings.warn(
+                    f"MoE expert overflow averaged {mean:.1%} over the last "
+                    f"{len(self._buf)} steps (threshold "
+                    f"{self.threshold:.0%}): tokens are being dropped at "
+                    f"expert capacity — the router may have collapsed; "
+                    f"raise capacity_factor or aux_weight",
+                    stacklevel=3)
+        else:
+            self._armed = True
+
+    def report(self) -> dict:
+        """Summary fields for the harness run() output."""
+        return {
+            "expert_overflow_window_mean": self.last_window_mean,
+            "expert_overflow_warnings": self.warning_count,
+        }
 
 
 def _collect(intermediates, name: str) -> list[jax.Array]:
@@ -59,7 +112,9 @@ class ExpertParallelEngine(Engine):
     """
 
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
-                 aux_weight: float = 0.01, router_z_weight: float = 0.0):
+                 aux_weight: float = 0.01, router_z_weight: float = 0.0,
+                 overflow_warn_threshold: float = 0.25,
+                 overflow_window: int = 50):
         # (data, expert) base mesh; an optional 'model' axis composes ep×tp
         # — each expert's FFN Megatron-split over it (models/moe.py
         # partition_model), still one GSPMD jit
@@ -71,6 +126,8 @@ class ExpertParallelEngine(Engine):
                 "mesh")
         self.aux_weight = aux_weight
         self.router_z_weight = router_z_weight
+        self.overflow_monitor = _OverflowMonitor(overflow_warn_threshold,
+                                                 overflow_window)
         super().__init__(model, optimizer, mesh, learning_rate)
         # tokens shard over the WHOLE mesh (see shard_batch), so batch
         # divisibility is against every device, not just the data axis
@@ -93,6 +150,11 @@ class ExpertParallelEngine(Engine):
 
     def init_state(self, rng, sample_x) -> TrainState:
         return self._init_partitioned_state(rng, sample_x)
+
+    def step(self, state, x, y):
+        state, metrics = super().step(state, x, y)
+        self.overflow_monitor.observe(metrics["overflow"])
+        return state, metrics
 
     def _build_step(self):
         apply_fn = self.model.apply

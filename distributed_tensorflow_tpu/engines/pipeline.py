@@ -188,6 +188,7 @@ class PipelineEngine(Engine):
         dtype: jnp.dtype = jnp.float32,
         stages: tuple[nn.Module, nn.Module, nn.Module] | None = None,
         schedule: str = "gpipe",
+        remat: bool = False,
     ):
         if mesh is None or not {meshlib.DATA_AXIS,
                                 meshlib.PIPE_AXIS} <= set(mesh.axis_names):
@@ -202,6 +203,16 @@ class PipelineEngine(Engine):
             raise ValueError(f"unknown schedule '{schedule}'; "
                              f"choose 'gpipe' or '1f1b'")
         self.schedule = schedule
+        # activation checkpointing for the gpipe tick body: AD through the
+        # tick scan stores one residual set per tick (M+S−1 of them); with
+        # remat it stores only each tick's block INPUT and recomputes the
+        # block forward in backward — the per-tick stash drops from every
+        # block intermediate (attention scores, FFN hidden) to one
+        # activation, at one extra block-forward per tick.  This is the
+        # memory-bounded long-context schedule pp×sp lacked (1F1B rejects a
+        # 'seq' axis; it already stash-and-recomputes by construction, so
+        # remat=True is a documented no-op there).
+        self.remat = remat and schedule == "gpipe"
         # optional Megatron TP inside each stage: 'model' is a GSPMD auto
         # axis — the shard_map is manual over (data, pipe) only, and the
         # stage params' with_partitioning annotations drive the in-stage
@@ -334,6 +345,15 @@ class PipelineEngine(Engine):
         embed, block, head = self.embed, self.block, self.head
         M = self.microbatches
         sp = self.sp_n
+
+        def block_apply(bp, h):
+            return block.apply({"params": bp}, h)
+
+        if self.remat:
+            # recompute-in-backward: safe under a manual 'seq' axis because
+            # the block runs unconditionally on every device each tick, so
+            # the ring's ppermutes replay symmetrically during recompute
+            block_apply = jax.checkpoint(block_apply)
         data_axis, pipe_axis = meshlib.DATA_AXIS, meshlib.PIPE_AXIS
         # with a manual 'seq' axis, per-device losses are per-token-block
         # partial means: they reduce (and the AD-boundary psum runs) over
@@ -386,7 +406,7 @@ class PipelineEngine(Engine):
 
                     h_in = lax.cond((stage == 0) & (i < M), inject,
                                     lambda _: buf, None)
-                    h_out = block.apply({"params": blocks_local}, h_in)
+                    h_out = block_apply(blocks_local, h_in)
                     # last stage drains microbatch i-(S-1); the head matmul
                     # and loss run only there (again lax.cond, not masking)
                     oi = i - (S - 1)

@@ -194,6 +194,8 @@ class BertTinyClassifier(nn.Module):
     attention_impl: str = "dense"
     seq_axis: str = "seq"
     partition_model: bool = False
+    remat: bool = False          # activation checkpointing per encoder
+                                 # layer (see models/gpt.py GPTLM.remat)
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -217,11 +219,14 @@ class BertTinyClassifier(nn.Module):
         x = BertEmbeddings(self.vocab_size, self.hidden, self.max_len,
                            self.partition_model, self.dtype)(token_ids, pos)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        # remat: train (arg 3) is a static python bool; x/pad_mask trace
+        layer_cls = (nn.remat(TransformerLayer, static_argnums=(3,))
+                     if self.remat else TransformerLayer)
         for _ in range(self.layers):
-            x = TransformerLayer(self.hidden, self.heads, self.ffn,
-                                 self.dropout_rate, self.attention_impl,
-                                 self.seq_axis, self.partition_model,
-                                 self.dtype)(x, pad_mask, train)
+            x = layer_cls(self.hidden, self.heads, self.ffn,
+                          self.dropout_rate, self.attention_impl,
+                          self.seq_axis, self.partition_model,
+                          self.dtype)(x, pad_mask, train)
         cls = x[:, 0]  # [CLS]: global position 0
         if seq_parallel:
             # only seq-device 0 holds the real [CLS]; replicate it so the

@@ -272,6 +272,16 @@ class GPTLM(nn.Module):
                                  # in every attention layer)
     kv_heads: int | None = None  # GQA/MQA: K/V heads < query heads
     tie_embeddings: bool = True
+    remat: bool = False          # activation checkpointing: store only each
+                                 # block's INPUT, recompute the block in
+                                 # backward — activation memory drops from
+                                 # O(layers · per-block intermediates) to
+                                 # O(layers · hidden) + one block's
+                                 # intermediates, at ~1/3 extra FLOPs.  The
+                                 # long-context lever: composes with
+                                 # ring/Ulysses seq parallelism (the ring's
+                                 # ppermutes replay symmetrically on every
+                                 # seq device during recompute).
     dtype: jnp.dtype = jnp.float32
 
     causal_lm = True  # read by engines/harness to select the LM data layout
@@ -326,12 +336,15 @@ class GPTLM(nn.Module):
             x = x + nn.Embed(self.max_len, self.hidden, dtype=self.dtype,
                              name="pos_embed")(pos)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        # remat: train (arg 2) is a static python bool; x and pos trace
+        block_cls = (nn.remat(GPTBlock, static_argnums=(2,)) if self.remat
+                     else GPTBlock)
         for _ in range(self.layers):
-            x = GPTBlock(self.hidden, self.heads, self.ffn,
-                         self.dropout_rate, self.attention_impl,
-                         self.seq_axis, self.partition_model,
-                         self.decode, self.max_len, rope, self.kv_heads,
-                         self.dtype)(x, train, pos if rope else None)
+            x = block_cls(self.hidden, self.heads, self.ffn,
+                          self.dropout_rate, self.attention_impl,
+                          self.seq_axis, self.partition_model,
+                          self.decode, self.max_len, rope, self.kv_heads,
+                          self.dtype)(x, train, pos if rope else None)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.tie_embeddings:
             # tied head: contraction against the (possibly vocab-sharded)

@@ -77,6 +77,10 @@ class ExperimentConfig:
                                            # kernel) when seq_parallel==1
     positional: str = "learned"            # GPT positions: learned | rope
     kv_heads: int | None = None            # GPT GQA: K/V heads < query heads
+    remat: bool = False                    # activation checkpointing: store
+                                           # block inputs only, recompute in
+                                           # backward (transformer models
+                                           # and the GPipe tick body)
     model_args: dict | None = None         # extra model constructor fields
                                            # (--model-arg KEY=VALUE): sizes
                                            # like hidden/layers/heads for the
@@ -309,6 +313,13 @@ def _resolve_model(config: ExperimentConfig, num_classes: int):
         return config.model_fn()
     kw = dict(config.model_args or {})
     forced = _lm_model_kw(config)
+    if config.remat:
+        if config.model not in _SEQUENCE_MODELS:
+            raise ValueError(
+                f"--remat checkpoints transformer blocks; --model "
+                f"{config.model} has none (sequence models: "
+                f"{'/'.join(_SEQUENCE_MODELS)})")
+        forced["remat"] = True
     if config.model in ("moe", "moe_mlp"):
         # router_top_k is a MODEL knob — it applies under any engine (a
         # -ep 1 run still routes).  router_z_weight is an ENGINE knob that
@@ -534,6 +545,8 @@ def _sequence_model(config: ExperimentConfig, train_ds, mode: str, **kw):
         return config.model_fn()
     if config.model in _SEQUENCE_MODELS:
         _require_token_data(train_ds, config, mode)
+        if config.remat:
+            kw["remat"] = True
         _check_reserved_model_args(
             config, {"num_classes", "dtype", *kw, *_lm_model_kw(config)},
             mode)
@@ -690,7 +703,8 @@ def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
                                 _global_batch(config, dp)),
                             dtype=modellib.resolve_dtype(config.dtype),
                             stages=stages,
-                            schedule=config.pipeline_schedule)
+                            schedule=config.pipeline_schedule,
+                            remat=config.remat)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
 
@@ -726,7 +740,8 @@ def _setup_pipeline_tp(config: ExperimentConfig) -> _Experiment:
                                 config, train_ds,
                                 _global_batch(config, dp)),
                             stages=stages,
-                            schedule=config.pipeline_schedule)
+                            schedule=config.pipeline_schedule,
+                            remat=config.remat)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
 
@@ -825,7 +840,8 @@ def _setup_pipeline_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
                             optimizer=_make_optimizer(
                                 config, train_ds, _global_batch(config, dp)),
                             stages=stages,
-                            schedule=config.pipeline_schedule)
+                            schedule=config.pipeline_schedule,
+                            remat=config.remat)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
 
@@ -990,6 +1006,11 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
             **({"test_perplexity": float(np.exp(min(ev["loss"], 80.0)))}
                if config.model in _LM_MODELS else {}),
         }
+        # expert-parallel runs surface the router-health watch (sustained
+        # capacity overflow warns during training; the summary records it)
+        monitor = getattr(ex.engine, "overflow_monitor", None)
+        if monitor is not None:
+            summary.update(monitor.report())
         sink.emit("summary", **summary)
         return summary
     finally:

@@ -262,3 +262,57 @@ def test_harness_expert_tp_cli():
     assert summary["engine"] == "expert_tp[dp*ep*tp]"
     assert summary["n_devices"] == 8
     assert summary["test_accuracy"] > 0.5
+
+
+# --------------------------------------------------- overflow watch (loud)
+
+
+def test_overflow_monitor_warns_once_per_episode():
+    """Sustained high overflow warns exactly once, re-arming only after the
+    window mean recovers below threshold (VERDICT r3 #10)."""
+    from distributed_tensorflow_tpu.engines.expert_parallel import (
+        _OverflowMonitor)
+
+    mon = _OverflowMonitor(threshold=0.25, window=5)
+    with pytest.warns(UserWarning, match="capacity_factor"):
+        for _ in range(5):
+            mon.observe(0.9)
+    assert mon.warning_count == 1
+    assert mon.last_window_mean == pytest.approx(0.9)
+    # still high: no second warning while un-armed
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        for _ in range(5):
+            mon.observe(0.8)
+    assert mon.warning_count == 1
+    # recovery re-arms ...
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        for _ in range(5):
+            mon.observe(0.0)
+    # ... so a new collapse warns again
+    with pytest.warns(UserWarning):
+        for _ in range(5):
+            mon.observe(0.8)
+    assert mon.warning_count == 2
+
+
+def test_collapsed_router_warns_through_engine():
+    """A capacity_factor starving the experts must surface as the loud
+    warning within a few steps, and the monitor's report carries the
+    summary fields."""
+    moe = create_model("moe", num_classes=10, num_experts=4, embed_dim=16,
+                       expert_hidden=16, partition_experts=True,
+                       capacity_factor=0.05)
+    eng = ExpertParallelEngine(moe, mesh=_ep_mesh(), overflow_window=3)
+    rnd = np.random.default_rng(0)
+    x = rnd.random((16, 28, 28, 1), np.float32)
+    y = (np.arange(16) % 10).astype(np.int32)
+    state = eng.init_state(jax.random.key(0), x)
+    with pytest.warns(UserWarning, match="overflow"):
+        for _ in range(3):
+            state, m = eng.step(state, *eng.shard_batch(x, y))
+    rep = eng.overflow_monitor.report()
+    assert rep["expert_overflow_warnings"] >= 1
+    assert rep["expert_overflow_window_mean"] > 0.25

@@ -487,3 +487,73 @@ def test_stage_model_args_unknown_key_rejected():
             engine="sync", model="gpt", dataset="lm_synth", n_devices=8,
             pipeline_parallel=2, microbatches=2, batch_size=8, epochs=1,
             log_every=0, model_args={"hidden": 64}))
+
+
+# ------------------------------------------------------------------ remat
+
+
+@pytest.mark.slow
+def test_gpipe_remat_grad_parity_and_memory():
+    """remat=True must change scheduling only — identical loss and SGD step
+    to remat=False — while the compiled step's temp (activation) memory
+    drops materially at M=8 (VERDICT r3 #5: gpipe stores one residual set
+    per tick, M+S-1 of them, without it)."""
+    from distributed_tensorflow_tpu.models.gpt import gpt_pipeline_stages
+
+    mesh = _mesh(2, 4)
+    rnd = np.random.default_rng(0)
+    tok = rnd.integers(0, 64, (16, 32)).astype(np.int32)
+    tgt = np.roll(tok, -1, axis=1).astype(np.int32)
+
+    out = {}
+    for remat in (False, True):
+        eng = PipelineEngine(
+            microbatches=8, mesh=mesh, optimizer=optax.sgd(0.1), remat=remat,
+            stages=gpt_pipeline_stages(vocab_size=64, hidden=64, heads=2,
+                                       ffn=256, max_len=32))
+        st = eng.init_state(jax.random.key(0), tok)
+        st, m = eng.step(st, *eng.shard_batch(tok, tgt))
+        mem = eng._jit_step.lower(
+            st, *eng.shard_batch(tok, tgt)).compile().memory_analysis()
+        out[remat] = (float(m["loss"]), jax.device_get(st.params),
+                      mem.temp_size_in_bytes)
+
+    assert out[False][0] == pytest.approx(out[True][0], abs=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-5),
+        out[False][1], out[True][1])
+    # measured on the 8-device CPU mesh: 4.6 MB -> 1.2 MB at M=8; assert a
+    # conservative 2x so minor XLA layout drift doesn't flake the test
+    assert out[True][2] < out[False][2] / 2, (out[True][2], out[False][2])
+
+
+@pytest.mark.slow
+def test_gpipe_remat_composes_with_seq_parallel():
+    """pp×sp + remat: the ring's collectives replay symmetrically during
+    recompute (block runs unconditionally each tick) — same oracle parity
+    as the non-remat pp×sp test."""
+    from distributed_tensorflow_tpu.models.gpt import gpt_pipeline_stages
+
+    lr = 0.1
+    eng = PipelineEngine(
+        microbatches=2, mesh=_pp_sp_mesh(), optimizer=optax.sgd(lr),
+        remat=True,
+        stages=gpt_pipeline_stages(vocab_size=64, hidden=32, heads=2,
+                                   ffn=64, max_len=16,
+                                   attention_impl="ring", seq_axis="seq"))
+    x, y = _lm_tokens()
+    state = eng.init_state(jax.random.key(0), x)
+    before = jax.device_get(state.params)
+    state, m = eng.step(state, *eng.shard_batch(x, y))
+    after = jax.device_get(state.params)
+
+    def ref_loss(params):
+        logits = eng._sequential_logits(params, x)
+        return cross_entropy(logits, jnp.asarray(y)).mean()
+
+    assert abs(float(m["loss"]) - float(ref_loss(before))) < 1e-5
+    grads = jax.grad(ref_loss)(before)
+    expected = jax.tree.map(lambda p, g: p - lr * g, before, grads)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(a, e, atol=2e-5, rtol=1e-4),
+        after, expected)
