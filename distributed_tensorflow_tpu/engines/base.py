@@ -78,13 +78,15 @@ def make_loss_fn(apply_fn: Callable) -> Callable:
     return loss_fn
 
 
-def gspmd_grad_accum(grad_fn, params, x, y, rng, K: int):
+def gspmd_grad_accum(grad_fn, params, x, y, rng, K: int, mesh=None,
+                     batch_axes=meshlib.DATA_AXIS):
     """K-microbatch gradient accumulation under GSPMD (global jit
     semantics): reshape the batch to (K, B/K, ...), `lax.scan` the
     microbatches, accumulate gradients, divide by K once.
 
-    ``grad_fn(params, xc, yc, rng_c) -> ((loss, acc), grads)`` — a
-    ``value_and_grad(..., has_aux=True)`` of a per-chunk mean loss.  The
+    ``grad_fn(params, xc, yc, rng_c) -> ((loss, aux), grads)`` — a
+    ``value_and_grad(..., has_aux=True)`` of a per-chunk mean loss; ``aux``
+    is any pytree of scalars, accumulated leaf-wise and K-averaged.  The
     returned gradient is then the global batch mean (mean of equal-chunk
     means), identical math to K=1 — the GSPMD counterpart of the sync
     engine's shard_map accumulation (engines/sync.py:68-111), but with no
@@ -95,37 +97,62 @@ def gspmd_grad_accum(grad_fn, params, x, y, rng, K: int):
     like the params themselves.
 
     Dropout draws an independent key per microbatch (fold_in on the chunk
-    index), matching K separate steps."""
+    index), matching K separate steps.
+
+    ``mesh``, when given, pins the microbatched inputs to
+    ``P(None, batch_axes, ...)`` (K replicated, batch sharded —
+    ``batch_axes`` defaults to 'data'; the expert engine passes its
+    ('data','expert') combined batch axes).  Without the
+    constraint the (B, ...) → (K, B/K, ...) reshape leaves the sharding
+    of the new leading axis to propagation, and inside the scan body the
+    partitioner can fail to move from its guess to what the embedding
+    gather needs — an "Involuntary full rematerialization"
+    (replicate-then-repartition) per microbatch on fsdp×tp BERT."""
     if x.shape[0] % K:
         raise ValueError(
             f"global batch {x.shape[0]} not divisible by grad_accum {K}")
     xm = x.reshape((K, x.shape[0] // K) + x.shape[1:])
     ym = y.reshape((K, y.shape[0] // K) + y.shape[1:])
+    if mesh is not None:
+        def pin(t):
+            spec = P(None, batch_axes,
+                     *([None] * (t.ndim - 2)))
+            return jax.lax.with_sharding_constraint(
+                t, NamedSharding(mesh, spec))
+
+        xm, ym = pin(xm), pin(ym)
 
     def micro(carry, chunk):
         g_acc, l_acc, a_acc, i = carry
         xc, yc = chunk
         (l, a), g = grad_fn(params, xc, yc, jax.random.fold_in(rng, i))
         return (jax.tree.map(jnp.add, g_acc, g),
-                l_acc + l, a_acc + a, i + 1), None
+                l_acc + l, jax.tree.map(jnp.add, a_acc, a), i + 1), None
 
+    # aux may be any pytree of scalars (acc, or (task, acc, overflow) for
+    # the MoE engine) — zeros come from an abstract eval, no FLOPs
+    aux_shape = jax.eval_shape(
+        lambda: grad_fn(params, xm[0], ym[0], rng)[0][1])
+    aux_init = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), aux_shape)
     zero = jnp.zeros((), jnp.float32)
-    init = (jax.tree.map(jnp.zeros_like, params), zero, zero,
+    init = (jax.tree.map(jnp.zeros_like, params), zero, aux_init,
             jnp.zeros((), jnp.int32))
     (g_sum, l_sum, a_sum, _), _ = jax.lax.scan(micro, init, (xm, ym))
     grads = jax.tree.map(lambda t: t / K, g_sum)
-    return grads, l_sum / K, a_sum / K
+    return grads, l_sum / K, jax.tree.map(lambda t: t / K, a_sum)
 
 
-def gspmd_value_and_grad(loss_fn, params, x, y, rng, K: int):
+def gspmd_value_and_grad(loss_fn, params, x, y, rng, K: int, mesh=None):
     """(grads, loss, acc) of a GSPMD step — direct at K == 1, K-microbatch
     accumulated otherwise.  The shared step core of the jit engines
-    (tensor_parallel, fsdp); ``loss_fn`` has the make_loss_fn signature."""
+    (tensor_parallel, fsdp); ``loss_fn`` has the make_loss_fn signature.
+    ``mesh`` pins microbatch shardings under accumulation (see
+    gspmd_grad_accum)."""
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     if K == 1:
         (loss, acc), grads = grad_fn(params, x, y, rng)
         return grads, loss, acc
-    return gspmd_grad_accum(grad_fn, params, x, y, rng, K)
+    return gspmd_grad_accum(grad_fn, params, x, y, rng, K, mesh=mesh)
 
 
 class Engine:

@@ -104,6 +104,19 @@ def _mean_named(intermediates, name: str) -> jax.Array:
     return (_sum_named(intermediates, name) / max(len(leaves), 1))
 
 
+def router_losses(intermediates):
+    """(aux_loss_sum, z_loss_sum, overflow_mean) from the sown router
+    diagnostics — the single definition of MoE loss extraction, shared by
+    this engine and the ep×sp composite (engines/composite.py) so the two
+    cannot silently diverge.  Overflow is stop-gradiented: it is a
+    diagnostic (fraction of routing assignments dropped at capacity), not
+    a loss term."""
+    aux = _sum_named(intermediates, "aux_loss")
+    z = _sum_named(intermediates, "z_loss")
+    overflow = jax.lax.stop_gradient(_mean_named(intermediates, "overflow"))
+    return aux, z, overflow
+
+
 class ExpertParallelEngine(Engine):
     """data × expert parallel sync training under one jit (GSPMD).
 
@@ -114,7 +127,7 @@ class ExpertParallelEngine(Engine):
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
                  aux_weight: float = 0.01, router_z_weight: float = 0.0,
                  overflow_warn_threshold: float = 0.25,
-                 overflow_window: int = 50):
+                 overflow_window: int = 50, grad_accum: int = 1):
         # (data, expert) base mesh; an optional 'model' axis composes ep×tp
         # — each expert's FFN Megatron-split over it (models/moe.py
         # partition_model), still one GSPMD jit
@@ -124,8 +137,11 @@ class ExpertParallelEngine(Engine):
             raise ValueError(
                 "ExpertParallelEngine requires a ('data','expert'[,'model']) "
                 "mesh")
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self.aux_weight = aux_weight
         self.router_z_weight = router_z_weight
+        self.grad_accum = grad_accum
         self.overflow_monitor = _OverflowMonitor(overflow_warn_threshold,
                                                  overflow_window)
         super().__init__(model, optimizer, mesh, learning_rate)
@@ -157,32 +173,39 @@ class ExpertParallelEngine(Engine):
         return state, metrics
 
     def _build_step(self):
+        from distributed_tensorflow_tpu.engines.base import gspmd_grad_accum
+
         apply_fn = self.model.apply
-        tx = self.tx
+        tx, K = self.tx, self.grad_accum
         aux_weight, z_weight = self.aux_weight, self.router_z_weight
+
+        def loss_fn(params, x, y, rng):
+            logits, col = apply_fn(
+                {"params": params}, x, train=True,
+                rngs={"dropout": rng}, mutable=["intermediates"])
+            task = cross_entropy(logits, y).mean()
+            # a collapsed router is visible in the overflow metric instead
+            # of as silent accuracy loss
+            aux, z, overflow = router_losses(col["intermediates"])
+            acc = (logits.argmax(-1) == y).mean()
+            return (task + aux_weight * aux + z_weight * z,
+                    (task, acc, overflow))
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
         def train_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
-
-            def loss_fn(params):
-                logits, col = apply_fn(
-                    {"params": params}, x, train=True,
-                    rngs={"dropout": rng}, mutable=["intermediates"])
-                inter = col["intermediates"]
-                task = cross_entropy(logits, y).mean()
-                aux = _sum_named(inter, "aux_loss")
-                z = _sum_named(inter, "z_loss")
-                # overflow is a diagnostic, not a loss: the fraction of
-                # routing assignments dropped at capacity — a collapsed
-                # router is visible here instead of as silent accuracy loss
-                overflow = jax.lax.stop_gradient(
-                    _mean_named(inter, "overflow"))
-                acc = (logits.argmax(-1) == y).mean()
-                return (task + aux_weight * aux + z_weight * z,
-                        (task, acc, overflow))
-
-            (loss, (task, acc, overflow)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state.params)
+            if K == 1:
+                ((loss, (task, acc, overflow)),
+                 grads) = grad_fn(state.params, x, y, rng)
+            else:
+                # K-microbatch accumulation (base.gspmd_grad_accum — the
+                # aux pytree (task, acc, overflow) is summed then /K):
+                # each microbatch runs its own expert all-to-alls, so the
+                # dispatch/combine memory drops ~K× like the activations
+                grads, loss, (task, acc, overflow) = gspmd_grad_accum(
+                    grad_fn, state.params, x, y, rng, K, mesh=self.mesh,
+                    batch_axes=(meshlib.DATA_AXIS, meshlib.EXPERT_AXIS))
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             return state.replace(step=state.step + 1, params=params,

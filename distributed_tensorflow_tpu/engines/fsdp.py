@@ -127,7 +127,7 @@ class FSDPEngine(Engine):
             # reduce-scatters the grad back to the owning shard; the
             # optimizer update below then runs fully sharded (ZeRO).
             grads, loss, acc = gspmd_value_and_grad(
-                loss_fn, state.params, x, y, rng, K)
+                loss_fn, state.params, x, y, rng, K, mesh=self.mesh)
             updates, opt_state = tx.update(grads, state.opt_state,
                                            state.params)
             params = optax.apply_updates(state.params, updates)

@@ -96,7 +96,7 @@ class TensorParallelEngine(Engine):
         def train_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
             grads, loss, acc = gspmd_value_and_grad(
-                loss_fn, state.params, x, y, rng, K)
+                loss_fn, state.params, x, y, rng, K, mesh=self.mesh)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             return state.replace(step=state.step + 1, params=params,

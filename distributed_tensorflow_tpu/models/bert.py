@@ -219,14 +219,19 @@ class BertTinyClassifier(nn.Module):
         x = BertEmbeddings(self.vocab_size, self.hidden, self.max_len,
                            self.partition_model, self.dtype)(token_ids, pos)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        # remat: train (arg 3) is a static python bool; x/pad_mask trace
+        # remat: train (arg 3) is a static python bool; x/pad_mask trace.
+        # Explicit name pins the module path to the unwrapped auto-name —
+        # nn.remat renames the class, and flax derives param paths + init
+        # RNG from the path, so without the pin remat=True would draw
+        # different params under different tree paths (see models/gpt.py).
         layer_cls = (nn.remat(TransformerLayer, static_argnums=(3,))
                      if self.remat else TransformerLayer)
-        for _ in range(self.layers):
+        for i in range(self.layers):
             x = layer_cls(self.hidden, self.heads, self.ffn,
                           self.dropout_rate, self.attention_impl,
                           self.seq_axis, self.partition_model,
-                          self.dtype)(x, pad_mask, train)
+                          self.dtype,
+                          name=f"TransformerLayer_{i}")(x, pad_mask, train)
         cls = x[:, 0]  # [CLS]: global position 0
         if seq_parallel:
             # only seq-device 0 holds the real [CLS]; replicate it so the

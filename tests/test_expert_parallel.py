@@ -316,3 +316,53 @@ def test_collapsed_router_warns_through_engine():
     rep = eng.overflow_monitor.report()
     assert rep["expert_overflow_warnings"] >= 1
     assert rep["expert_overflow_window_mean"] > 0.25
+
+
+def test_expert_parallel_grad_accum_parity(mesh8):
+    """grad_accum=2 with no capacity pressure (capacity_factor=num_experts
+    → zero drops) and aux_weight=0 is pure scheduling: task grads are
+    linear in the batch, so the K=2 step matches K=1.  (With aux losses or
+    tight capacity the per-chunk routing statistics legitimately differ —
+    that is microbatched MoE semantics, not an accumulation bug.)"""
+    import optax
+
+    rng = np.random.default_rng(0)
+    x = rng.random((16, 28, 28, 1), np.float32)
+    y = (np.arange(16) % 10).astype(np.int32)
+    mesh = _ep_mesh(2, 4)
+    out = {}
+    for K in (1, 2):
+        model = create_model("moe", num_classes=10, num_experts=4,
+                             embed_dim=16, expert_hidden=16,
+                             capacity_factor=4.0, dropout_rate=0.0,
+                             partition_experts=True)
+        eng = ExpertParallelEngine(model, optimizer=optax.sgd(0.1),
+                                   mesh=mesh, aux_weight=0.0,
+                                   router_z_weight=0.0, grad_accum=K)
+        st = eng.init_state(jax.random.key(0), x)
+        st, m = eng.step(st, *eng.shard_batch(x, y))
+        out[K] = (float(m["loss"]), float(m["overflow"]),
+                  jax.device_get(st.params))
+    assert out[1][1] == 0.0 and out[2][1] == 0.0  # no drops by construction
+    assert out[1][0] == pytest.approx(out[2][0], abs=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-5),
+        out[1][2], out[2][2])
+
+
+def test_expert_parallel_grad_accum_trains(mesh8):
+    """Accumulated MoE training with the real aux losses still learns."""
+    rng = np.random.default_rng(1)
+    x = rng.random((32, 28, 28, 1), np.float32)
+    y = (np.arange(32) % 10).astype(np.int32)
+    model = create_model("moe", num_classes=10, num_experts=4,
+                         embed_dim=16, expert_hidden=32,
+                         partition_experts=True)
+    eng = ExpertParallelEngine(model, mesh=_ep_mesh(2, 4), learning_rate=1e-2,
+                               grad_accum=2)
+    st = eng.init_state(jax.random.key(0), x)
+    xs, ys = eng.shard_batch(x, y)
+    st, first = eng.step(st, xs, ys)
+    for _ in range(20):
+        st, m = eng.step(st, xs, ys)
+    assert float(m["loss"]) < float(first["loss"])

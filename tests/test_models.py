@@ -122,3 +122,36 @@ def test_bert_flash_matches_dense():
     ld = dense.apply({"params": params}, ids, train=False)
     lf = flash.apply({"params": params}, ids, train=False)
     np.testing.assert_allclose(ld, lf, atol=1e-4, rtol=1e-4)
+
+
+def test_bert_remat_param_and_grad_parity():
+    """Model-level remat on BERT is a scheduling change only: identical
+    param tree (paths AND values — nn.remat must not perturb the flax
+    scope names or init RNG streams) and identical grads."""
+    import optax
+
+    kw = dict(num_classes=2, vocab_size=100, max_len=32, dropout_rate=0.0)
+    ids = jnp.array(np.random.default_rng(2).integers(1, 100, (4, 16)))
+    labels = jnp.array([0, 1, 0, 1])
+    out = {}
+    for remat in (False, True):
+        model = create_model("bert_tiny", remat=remat, **kw)
+        params = model.init(jax.random.key(0), ids, train=False)["params"]
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, ids, train=False)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        out[remat] = (float(loss), jax.device_get(params),
+                      jax.device_get(grads))
+    # identical tree structure (same param paths) ...
+    assert (jax.tree_util.tree_structure(out[False][1])
+            == jax.tree_util.tree_structure(out[True][1]))
+    # ... identical values and grads
+    assert out[False][0] == pytest.approx(out[True][0], abs=1e-6)
+    jax.tree.map(np.testing.assert_array_equal, out[False][1], out[True][1])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-5),
+        out[False][2], out[True][2])

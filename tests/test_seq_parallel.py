@@ -175,3 +175,32 @@ def test_flash_attention_rejected_with_seq_parallel():
         run(ExperimentConfig(model="bert_tiny", dataset="glue_synth",
                              attention_impl="flash", seq_parallel=4,
                              n_devices=8))
+
+
+def test_seq_parallel_grad_accum_parity(text_data):
+    """grad_accum=2 under dp×sp is pure scheduling: mean-of-chunk-means
+    equals the full-batch mean (no dropout, SGD), so loss and params match
+    the K=1 step."""
+    import optax
+
+    tr, _ = text_data
+    x, y = tr.x[:16], tr.y[:16]
+    out = {}
+    for K in (1, 2):
+        eng = SeqParallelEngine(tiny_bert("ring"), optimizer=optax.sgd(0.1),
+                                mesh=seq_mesh(2, 4), grad_accum=K)
+        st = eng.init_state(jax.random.key(0), x)
+        st, m = eng.step(st, *eng.shard_batch(x, y))
+        out[K] = (float(m["loss"]), jax.device_get(st.params))
+    assert out[1][0] == pytest.approx(out[2][0], abs=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-5),
+        out[1][1], out[2][1])
+
+
+def test_seq_parallel_grad_accum_validates():
+    import optax
+
+    with pytest.raises(ValueError, match="grad_accum"):
+        SeqParallelEngine(tiny_bert("ring"), optimizer=optax.sgd(0.1),
+                          mesh=seq_mesh(2, 4), grad_accum=0)
