@@ -144,8 +144,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="activation checkpointing: store each transformer "
                         "block's input only, recompute the block in "
                         "backward (~K x less activation memory for ~1/3 "
-                        "more FLOPs; also bounds the GPipe tick stash). "
-                        "The long-context memory lever")
+                        "more FLOPs).  The long-context memory lever.  "
+                        "Sequence models only; under pipelines it bounds "
+                        "the GPipe tick stash but is a documented no-op "
+                        "for --pipeline-schedule 1f1b (the 1F1B stash is "
+                        "already bounded at S slots)")
     p.add_argument("--model-arg", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="extra model constructor field (repeatable), e.g. "

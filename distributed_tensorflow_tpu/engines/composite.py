@@ -39,24 +39,60 @@ from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
 
 class CompositeEngine(Engine):
-    """Sync training over a ('data', 'model', 'seq') mesh.
+    """Sync training over a ('data', 'model', 'seq'[, 'expert']) mesh.
 
     Any axis may have size 1; ``seq`` > 1 requires a model whose
     ``attention_impl`` is 'ring', 'ring_flash' or 'ulysses' (dense attention on
     seq-sharded activations would attend within local blocks only).
-    """
+
+    An ``expert`` axis (ep×sp — the long-context MoE shape) requires a
+    model with MoE blocks carrying ``with_partitioning('expert', ...)``
+    annotations (models/gpt.py ``moe_experts`` + ``partition_experts``):
+    the expert dispatch einsums stay GSPMD over 'expert' — each manual-seq
+    token block routes to the globally-sharded experts — while the router's
+    aux/z losses join the objective exactly as in
+    engines/expert_parallel.py (same _OverflowMonitor on the overflow
+    diagnostic)."""
 
     seq_axis = meshlib.SEQ_AXIS
 
-    def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3):
+    def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
+                 aux_weight: float = 0.01, router_z_weight: float = 0.0,
+                 overflow_warn_threshold: float = 0.25,
+                 overflow_window: int = 50):
+        from distributed_tensorflow_tpu.engines.expert_parallel import (
+            _OverflowMonitor)
+
         if mesh is None or meshlib.DATA_AXIS not in mesh.axis_names:
             raise ValueError("CompositeEngine requires a mesh with a 'data' "
-                             "axis (plus optional 'model'/'seq')")
+                             "axis (plus optional 'model'/'seq'/'expert')")
         extra = set(mesh.axis_names) - {meshlib.DATA_AXIS, meshlib.MODEL_AXIS,
-                                        meshlib.SEQ_AXIS}
+                                        meshlib.SEQ_AXIS, meshlib.EXPERT_AXIS}
         if extra:
             raise ValueError(f"unsupported mesh axes {sorted(extra)}; "
-                             f"CompositeEngine composes data×model×seq")
+                             f"CompositeEngine composes data×model×seq×expert")
+        self.moe = getattr(model, "moe_experts", 0) > 0
+        self.ep_n = mesh.shape.get(meshlib.EXPERT_AXIS, 1)
+        if self.ep_n > 1:
+            if not self.moe:
+                raise ValueError(
+                    "mesh has an 'expert' axis but the model has no MoE "
+                    "blocks (moe_experts == 0); experts would silently "
+                    "replicate")
+            if not getattr(model, "partition_experts", False):
+                raise ValueError(
+                    "an 'expert' mesh axis needs partition_experts=True on "
+                    "the model — without the with_partitioning('expert') "
+                    "annotations the expert weights replicate and no "
+                    "expert parallelism happens")
+            if getattr(model, "moe_experts", 0) % self.ep_n:
+                raise ValueError(
+                    f"moe_experts {model.moe_experts} not divisible by "
+                    f"expert axis size {self.ep_n}")
+        self.aux_weight = aux_weight
+        self.router_z_weight = router_z_weight
+        self.overflow_monitor = _OverflowMonitor(overflow_warn_threshold,
+                                                 overflow_window)
         super().__init__(model, optimizer, mesh, learning_rate)
         self.seq_n = mesh.shape.get(meshlib.SEQ_AXIS, 1)
         self.tp_n = mesh.shape.get(meshlib.MODEL_AXIS, 1)
@@ -112,11 +148,22 @@ class CompositeEngine(Engine):
         return xs, ys, ms
 
     # ------------------------------------------------------------------ step
+    def step(self, state, x, y):
+        state, metrics = super().step(state, x, y)
+        if self.moe:
+            self.overflow_monitor.observe(metrics["overflow"])
+        return state, metrics
+
     def _build_step(self):
+        from distributed_tensorflow_tpu.engines.expert_parallel import (
+            router_losses)
+
         apply_fn = self.model.apply
         tx = self.tx
         seq_axis, manual = self.seq_axis, self._manual_seq
         lm, sp = self.lm, self.seq_n
+        moe = self.moe
+        aux_weight, z_weight = self.aux_weight, self.router_z_weight
 
         def train_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
@@ -126,8 +173,20 @@ class CompositeEngine(Engine):
                 rng = jax.random.fold_in(rng, coll.axis_index(seq_axis))
 
             def loss_fn(params):
-                logits = apply_fn({"params": params}, x, train=True,
-                                  rngs={"dropout": rng})
+                if moe:
+                    # routed blocks sow aux_loss/z_loss/overflow; under
+                    # manual seq each device's router stats cover its own
+                    # token block — the same 1/sp scaling as the task loss
+                    # makes the transpose psum the mean-over-blocks aux
+                    # gradient
+                    logits, col = apply_fn(
+                        {"params": params}, x, train=True,
+                        rngs={"dropout": rng}, mutable=["intermediates"])
+                    aux, z, overflow = router_losses(col["intermediates"])
+                else:
+                    logits = apply_fn({"params": params}, x, train=True,
+                                      rngs={"dropout": rng})
+                    aux = z = overflow = jnp.zeros((), jnp.float32)
                 # global-batch mean: 'data' is a GSPMD axis in both paths, so
                 # the mean is global as written.  Over 'seq': classification
                 # logits are invariant ([CLS] broadcast) and the loss needs
@@ -138,19 +197,26 @@ class CompositeEngine(Engine):
                 ce = cross_entropy_onehot if (manual and lm) else cross_entropy
                 loss = ce(logits, y).mean()
                 acc = (logits.argmax(-1) == y).mean()
+                total = loss + aux_weight * aux + z_weight * z
                 scale = sp if (manual and lm) else 1
-                return loss / scale, (loss, acc)
+                return total / scale, (loss, acc, total, overflow)
 
-            (_, (loss, acc)), grads = jax.value_and_grad(
+            (_, (loss, acc, total, overflow)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             if manual and lm:  # per-seq-block values → report global means
                 loss = jax.lax.pmean(loss, seq_axis)
                 acc = jax.lax.pmean(acc, seq_axis)
+            if manual and moe:  # router stats are per-seq-block too
+                total = jax.lax.pmean(total, seq_axis)
+                overflow = jax.lax.pmean(overflow, seq_axis)
+            metrics = {"loss": loss, "accuracy": acc}
+            if moe:
+                metrics["total_loss"] = total
+                metrics["overflow"] = overflow
             return state.replace(step=state.step + 1, params=params,
-                                 opt_state=opt_state), \
-                {"loss": loss, "accuracy": acc}
+                                 opt_state=opt_state), metrics
 
         if not manual:
             return jax.jit(train_step, donate_argnums=0)

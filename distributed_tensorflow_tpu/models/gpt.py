@@ -205,7 +205,15 @@ class CausalSelfAttention(nn.Module):
 
 
 class GPTBlock(nn.Module):
-    """Pre-LN decoder block: x + attn(LN(x)); x + mlp(LN(x))."""
+    """Pre-LN decoder block: x + attn(LN(x)); x + ffn(LN(x)).
+
+    ``moe_experts > 0`` swaps the dense FFN for a routed MoE layer
+    (models/moe.py MoELayer) over the block's tokens — the long-context
+    MoE shape: under sequence parallelism each seq device routes its own
+    token block to the globally-sharded experts (the dispatch einsums stay
+    GSPMD over 'expert' while 'seq' is a manual shard_map axis,
+    engines/composite.py).  The router's aux/z losses and overflow sow
+    into ``intermediates`` exactly as in MoEClassifier."""
 
     hidden: int = 128
     heads: int = 4
@@ -219,6 +227,10 @@ class GPTBlock(nn.Module):
     rope: bool = False
     kv_heads: int | None = None
     dtype: jnp.dtype = jnp.float32
+    moe_experts: int = 0         # 0 = dense FFN; >0 = routed experts
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    partition_experts: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False, pos=None):
@@ -229,19 +241,31 @@ class GPTBlock(nn.Module):
                                     nn.LayerNorm(dtype=self.dtype)(x), pos)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
-        # Megatron FFN: column-parallel up, row-parallel down
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        y = nn.Dense(
-            self.ffn, dtype=self.dtype,
-            kernel_init=_part(nn.initializers.lecun_normal(),
-                              (None, meshlib.MODEL_AXIS), tp),
-            bias_init=_part(nn.initializers.zeros_init(),
-                            (meshlib.MODEL_AXIS,), tp))(y)
-        y = nn.gelu(y)
-        y = nn.Dense(
-            self.hidden, dtype=self.dtype,
-            kernel_init=_part(nn.initializers.lecun_normal(),
-                              (meshlib.MODEL_AXIS, None), tp))(y)
+        if self.moe_experts > 0:
+            from distributed_tensorflow_tpu.models.moe import MoELayer
+
+            b, l, d = y.shape
+            y = MoELayer(num_experts=self.moe_experts, hidden=self.ffn,
+                         capacity_factor=self.moe_capacity_factor,
+                         router_top_k=self.moe_top_k,
+                         partition_experts=self.partition_experts,
+                         partition_model=tp and self.partition_experts,
+                         dtype=self.dtype)(y.reshape(b * l, d))
+            y = y.reshape(b, l, d)
+        else:
+            # Megatron FFN: column-parallel up, row-parallel down
+            y = nn.Dense(
+                self.ffn, dtype=self.dtype,
+                kernel_init=_part(nn.initializers.lecun_normal(),
+                                  (None, meshlib.MODEL_AXIS), tp),
+                bias_init=_part(nn.initializers.zeros_init(),
+                                (meshlib.MODEL_AXIS,), tp))(y)
+            y = nn.gelu(y)
+            y = nn.Dense(
+                self.hidden, dtype=self.dtype,
+                kernel_init=_part(nn.initializers.lecun_normal(),
+                                  (meshlib.MODEL_AXIS, None), tp))(y)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return x + y
 
@@ -272,6 +296,15 @@ class GPTLM(nn.Module):
                                  # in every attention layer)
     kv_heads: int | None = None  # GQA/MQA: K/V heads < query heads
     tie_embeddings: bool = True
+    moe_experts: int = 0         # >0: every block's FFN is a routed MoE
+                                 # layer (models/moe.py) — the long-context
+                                 # MoE shape; composes with ring/Ulysses
+                                 # seq parallelism (engines/composite.py
+                                 # ep×sp: experts GSPMD-sharded over
+                                 # 'expert' while 'seq' stays manual)
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    partition_experts: bool = False
     remat: bool = False          # activation checkpointing: store only each
                                  # block's INPUT, recompute the block in
                                  # backward — activation memory drops from
@@ -292,11 +325,21 @@ class GPTLM(nn.Module):
                                                "ulysses")
         lq = token_ids.shape[1]
         if self.decode:
-            if seq_parallel or self.partition_model:
+            if seq_parallel:
+                # the hard constraint: ring/ulysses run inside shard_map
+                # with a manual 'seq' axis whose collectives assume every
+                # device holds a full-length sequence block — a one-token
+                # decode step has no seq dimension to shard, so there is
+                # nothing for the ring to rotate.  Decode instead uses
+                # dense cache attention; multi-device decode shards the
+                # BATCH over 'data' and (optionally, GSPMD) the heads/vocab
+                # over 'model' — see `generate(mesh=...)`.
                 raise ValueError(
-                    "decode mode is single-device (dense cache attention); "
-                    "clone the model with attention_impl='dense', "
-                    "partition_model=False — `generate` does this")
+                    "decode mode is incompatible with sequence-parallel "
+                    "attention (ring/ring_flash/ulysses run in shard_map "
+                    "over 'seq'; a 1-token step has no sequence to shard); "
+                    "clone with attention_impl='dense' — `generate` does "
+                    "this.  partition_model decode IS supported (GSPMD).")
             # the model-level cursor feeds the position embedding; each
             # attention layer keeps its own cache cursor in lockstep.  Not
             # advanced during .init() (same guard as the attention cache).
@@ -336,15 +379,31 @@ class GPTLM(nn.Module):
             x = x + nn.Embed(self.max_len, self.hidden, dtype=self.dtype,
                              name="pos_embed")(pos)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        # remat: train (arg 2) is a static python bool; x and pos trace
+        # remat: train (arg 2) is a static python bool; x and pos trace.
+        # The wrapped class is instantiated with an explicit name pinned to
+        # the unwrapped auto-name ("GPTBlock_{i}") — nn.remat renames the
+        # class, and flax derives both the param-tree path AND the init RNG
+        # stream from the module path, so without the pin a remat=True model
+        # would initialize *different* params under *different* paths
+        # (breaking remat/non-remat grad parity and cross-flag checkpoint
+        # restore).
+        if self.remat and self.moe_experts:
+            raise ValueError(
+                "remat + MoE blocks is unsupported: the router's sown "
+                "intermediates (aux_loss/z_loss/overflow) would be re-sown "
+                "during backward recompute, double-counting the balance "
+                "losses; train MoE blocks without --remat")
         block_cls = (nn.remat(GPTBlock, static_argnums=(2,)) if self.remat
                      else GPTBlock)
-        for _ in range(self.layers):
+        for i in range(self.layers):
             x = block_cls(self.hidden, self.heads, self.ffn,
                           self.dropout_rate, self.attention_impl,
                           self.seq_axis, self.partition_model,
                           self.decode, self.max_len, rope, self.kv_heads,
-                          self.dtype)(x, train, pos if rope else None)
+                          self.dtype, self.moe_experts, self.moe_top_k,
+                          self.moe_capacity_factor, self.partition_experts,
+                          name=f"GPTBlock_{i}")(x, train,
+                                                pos if rope else None)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.tie_embeddings:
             # tied head: contraction against the (possibly vocab-sharded)
@@ -361,7 +420,8 @@ class GPTLM(nn.Module):
 
 
 def generate(model: GPTLM, params, prompt, max_new_tokens: int, *,
-             temperature: float = 1.0, greedy: bool = False, rng=None):
+             temperature: float = 1.0, greedy: bool = False, rng=None,
+             mesh=None):
     """Autoregressive sampling with a KV cache: (B, Lp) prompt →
     (B, max_new_tokens) continuation.
 
@@ -374,12 +434,34 @@ def generate(model: GPTLM, params, prompt, max_new_tokens: int, *,
     takes the argmax; otherwise tokens draw from
     ``softmax(logits / temperature)``.  Cache correctness is oracle-tested
     against teacher-forced full-forward rollout (tests/test_gpt.py).
+
+    ``mesh`` enables multi-device decoding (GSPMD — the inference
+    counterpart of the training-side parallelism):
+
+    * the prompt batch and every cache leaf shard over the ``data`` axis
+      (batch-parallel sampling: B must divide by the axis size);
+    * with ``model.partition_model`` and a ``model`` mesh axis, params
+      keep their Megatron layout — QKV/FFN matmuls stay head-sharded and
+      the tied vocab-sharded head emits vocab-sharded logits whose
+      argmax/categorical XLA resolves with its own collectives (TP
+      decode).  Params already committed to the mesh (e.g. a TP engine's
+      TrainState) are used in place; unsharded params replicate.
+    * sequence-parallel attention cannot decode (see the in-model error:
+      shard_map's manual 'seq' collectives need a sequence dimension a
+      1-token step lacks) — ``generate`` always decodes with dense cache
+      attention regardless of the training-time ``attention_impl``.
+
+    Multi-device parity vs the single-device sampler is oracle-tested in
+    tests/test_gpt.py.
     """
     import jax
     from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
+    keep_tp = (mesh is not None and model.partition_model
+               and meshlib.MODEL_AXIS in mesh.axis_names)
     dm = model.clone(decode=True, attention_impl="dense",
-                     partition_model=False, dropout_rate=0.0)
+                     partition_model=keep_tp, dropout_rate=0.0)
     prompt = jnp.asarray(prompt)
     b, lp = prompt.shape
     if lp + max_new_tokens > model.max_len:
@@ -398,6 +480,41 @@ def generate(model: GPTLM, params, prompt, max_new_tokens: int, *,
         lambda: dm.init(jax.random.key(0), prompt[:, :1],
                         train=False))["cache"]
     cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+
+    if mesh is not None:
+        if meshlib.DATA_AXIS in mesh.axis_names:
+            dp = mesh.shape[meshlib.DATA_AXIS]
+            if b % dp:
+                raise ValueError(
+                    f"batch {b} not divisible by the data axis ({dp})")
+            batch_spec = P(meshlib.DATA_AXIS)
+        else:
+            batch_spec = P()
+        prompt = jax.device_put(
+            prompt, NamedSharding(mesh, P(*batch_spec, None)))
+        # cache leaves are (B, ...) tensors (KV, cursors are scalars):
+        # shard the batch dim, replicate scalars
+        cache = jax.tree.map(
+            lambda t: jax.device_put(
+                t, NamedSharding(
+                    mesh,
+                    P(*batch_spec, *([None] * (t.ndim - 1)))
+                    if t.ndim else P())),
+            cache)
+        # params committed to this mesh (TP TrainState) are used in place;
+        # anything else replicates onto the mesh
+        repl = NamedSharding(mesh, P())
+
+        def place(t):
+            sh = getattr(t, "sharding", None)
+            if (isinstance(sh, NamedSharding)
+                    and sh.mesh.devices.tolist() == mesh.devices.tolist()):
+                return t
+            return jax.device_put(t, repl)
+
+        params = jax.tree.map(place, params)
+        rng = jax.device_put(rng, repl)
+
     run = _compiled_sampler(dm, max_new_tokens, bool(greedy),
                             float(temperature))
     return run(params, cache, prompt, rng)

@@ -133,3 +133,46 @@ def test_step_timer():
     assert s["steps"] == 5
     assert s["total_s"] >= 0
     assert "p90_s" in s and "first_step_s" in s
+
+
+def test_checkpoint_cross_remat_restore(mesh8, tmp_path):
+    """A checkpoint saved WITHOUT --remat must restore into a --remat model
+    (and keep training identically): the remat flag only changes the
+    gradient schedule, so the param-tree paths must match exactly.  Guards
+    the nn.remat scope-rename regression (models/gpt.py GPTLM.remat)."""
+    import optax
+
+    from distributed_tensorflow_tpu.models import create_model
+    from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+    kw = dict(num_classes=64, hidden=32, layers=2, heads=2, ffn=64,
+              max_len=64, dropout_rate=0.0)
+    rng = np.random.default_rng(0)
+    x = rng.integers(1, 64, (16, 16)).astype(np.int32)
+    y = np.roll(x, -1, axis=1)
+
+    plain = SyncEngine(create_model("gpt", remat=False, **kw),
+                       optimizer=optax.sgd(0.1), mesh=mesh8)
+    state = plain.init_state(jax.random.key(0), x)
+    xs, ys = plain.shard_batch(x, y)
+    state, _ = plain.step(state, xs, ys)
+    jax.block_until_ready(state)
+    mgr = CheckpointManager(tmp_path / "x")
+    mgr.save(state)
+
+    rem = SyncEngine(create_model("gpt", remat=True, **kw),
+                     optimizer=optax.sgd(0.1), mesh=mesh8)
+    template = rem.init_state(jax.random.key(0), x)
+    restored = mgr.restore(template)   # raises if param paths diverge
+    assert_states_equal(state, restored)
+
+    # both continue from the restored point with matching trajectories
+    # (allclose, not exact: remat's backward recompute fuses differently,
+    # so params drift at the ~1e-10 float-reassociation level)
+    state, m0 = plain.step(state, xs, ys)
+    restored, m1 = rem.step(restored, xs, ys)
+    assert float(m0["loss"]) == pytest.approx(float(m1["loss"]), abs=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=1e-6, rtol=1e-5),
+        jax.device_get(state.params), jax.device_get(restored.params))

@@ -664,3 +664,74 @@ def test_remat_cli_rejects_non_transformer():
     with pytest.raises(ValueError, match="remat"):
         run(ExperimentConfig(engine="sync", model="mlp", dataset="synthetic",
                              n_devices=8, remat=True))
+
+
+# -------------------------------------------------- multi-device generate
+
+
+def test_generate_batch_parallel_matches_single_device(lm_data):
+    """generate(mesh=...) shards the prompt batch over 'data': tokens must
+    be identical to the single-device sampler (same params, same rng)."""
+    from distributed_tensorflow_tpu.models.gpt import generate
+
+    tr, _ = lm_data
+    model = tiny_gpt()
+    x = tr.x[:8, :8]
+    params = model.init(jax.random.key(0), x, train=False)["params"]
+
+    ref = np.asarray(generate(model, params, x, max_new_tokens=5,
+                              greedy=True))
+    mesh = meshlib.create_mesh(8)
+    out = np.asarray(generate(model, params, x, max_new_tokens=5,
+                              greedy=True, mesh=mesh))
+    np.testing.assert_array_equal(ref, out)
+
+
+@pytest.mark.slow
+def test_generate_tp_decode_matches_single_device(lm_data):
+    """TP decode: a partition_model GPT generates under a ('data','model')
+    mesh with params kept Megatron-sharded — tokens must match the
+    single-device sampler on the same (replicated) params."""
+    from distributed_tensorflow_tpu.models.gpt import generate
+
+    tr, _ = lm_data
+    tp_model = tiny_gpt(partition_model=True)
+    plain = tiny_gpt(partition_model=False)
+    x = tr.x[:4, :8]
+    # init unsharded (annotations only box metadata at init under jit);
+    # reference tokens from the plain clone on identical param values
+    params = jax.tree.map(
+        lambda l: getattr(l, "value", l),
+        tp_model.init(jax.random.key(1), x, train=False)["params"])
+    ref = np.asarray(generate(plain, params, x, max_new_tokens=5,
+                              greedy=True))
+
+    mesh = meshlib.create_mesh(
+        8, shape=(2, 4),
+        axis_names=(meshlib.DATA_AXIS, meshlib.MODEL_AXIS))
+    out = np.asarray(generate(tp_model, params, x, max_new_tokens=5,
+                              greedy=True, mesh=mesh))
+    np.testing.assert_array_equal(ref, out)
+
+
+def test_gpt_seq_parallel_grad_accum_parity(lm_data):
+    """grad_accum under dp×sp with an LM: loss/acc vary over BOTH manual
+    axes (per-token blocks), exercising the varying-carry scan path."""
+    import optax
+
+    tr, _ = lm_data
+    x, y = tr.x[:8], tr.y[:8]
+    mesh = meshlib.create_mesh(
+        8, shape=(2, 4), axis_names=(meshlib.DATA_AXIS, meshlib.SEQ_AXIS))
+    out = {}
+    for K in (1, 2):
+        model = tiny_gpt("ring")
+        eng = SeqParallelEngine(model, optimizer=optax.sgd(0.1), mesh=mesh,
+                                grad_accum=K)
+        st = eng.init_state(jax.random.key(0), x)
+        st, m = eng.step(st, *eng.shard_batch(x, y))
+        out[K] = (float(m["loss"]), jax.device_get(st.params))
+    assert out[1][0] == pytest.approx(out[2][0], abs=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-5),
+        out[1][1], out[2][1])

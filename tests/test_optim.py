@@ -61,16 +61,27 @@ def test_grad_accum_indivisible_batch_rejected(mnist):
 
 
 def test_grad_accum_engine_support():
-    """grad_accum composes with the sync/allreduce/fsdp engines and with
-    tensor_parallel (GSPMD accumulation, round 4); the async/gossip engines
-    and the manual-axis modes (seq, expert) still reject it loudly."""
+    """grad_accum composes with sync/allreduce/fsdp, tensor_parallel,
+    seq_parallel and expert_parallel (round 5); the async/gossip engines
+    and the pipeline modes still reject it loudly.  The seq/expert cases
+    assert routing-to-the-engine via the cheap divisibility check (a full
+    accumulated run is the parity tests' job)."""
     with pytest.raises(ValueError, match="grad_accum"):
         run(ExperimentConfig(engine="async", grad_accum=2, n_devices=8))
     with pytest.raises(ValueError, match="grad_accum"):
+        run(ExperimentConfig(model="gpt", dataset="lm_synth",
+                             pipeline_parallel=4, grad_accum=2, n_devices=8))
+    # seq/expert: accepted (not rejected) — an indivisible K hits the
+    # mode's divisibility validation, proving the flag reaches the engine
+    # seq: dp=2, global batch 6 → per-shard 3, 3 % 2 != 0
+    with pytest.raises(ValueError, match="not divisible by"):
         run(ExperimentConfig(model="bert_tiny", dataset="glue_synth",
-                             seq_parallel=4, grad_accum=2, n_devices=8))
-    with pytest.raises(ValueError, match="grad_accum"):
-        run(ExperimentConfig(model="moe", expert_parallel=4, grad_accum=2,
+                             seq_parallel=4, batch_size=6, grad_accum=2,
+                             per_worker_batch=False, n_devices=8))
+    # expert: 8 token shards, global batch 9 → 9 % 2 != 0
+    with pytest.raises(ValueError, match="not divisible by"):
+        run(ExperimentConfig(model="moe", expert_parallel=4, batch_size=9,
+                             grad_accum=2, per_worker_batch=False,
                              n_devices=8))
 
 
