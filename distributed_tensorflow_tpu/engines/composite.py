@@ -59,7 +59,7 @@ class CompositeEngine(Engine):
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
                  aux_weight: float = 0.01, router_z_weight: float = 0.0,
                  overflow_warn_threshold: float = 0.25,
-                 overflow_window: int = 50):
+                 overflow_window: int = 50, grad_accum: int = 1):
         from distributed_tensorflow_tpu.engines.expert_parallel import (
             _OverflowMonitor)
 
@@ -89,6 +89,9 @@ class CompositeEngine(Engine):
                 raise ValueError(
                     f"moe_experts {model.moe_experts} not divisible by "
                     f"expert axis size {self.ep_n}")
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        self.grad_accum = grad_accum
         self.aux_weight = aux_weight
         self.router_z_weight = router_z_weight
         self.overflow_monitor = _OverflowMonitor(overflow_warn_threshold,
@@ -155,15 +158,98 @@ class CompositeEngine(Engine):
         return state, metrics
 
     def _build_step(self):
+        from distributed_tensorflow_tpu.engines.base import gspmd_grad_accum
         from distributed_tensorflow_tpu.engines.expert_parallel import (
             router_losses)
 
         apply_fn = self.model.apply
-        tx = self.tx
+        tx, K = self.tx, self.grad_accum
         seq_axis, manual = self.seq_axis, self._manual_seq
         lm, sp = self.lm, self.seq_n
         moe = self.moe
         aux_weight, z_weight = self.aux_weight, self.router_z_weight
+
+        def loss_fn(params, x, y, rng):
+            if moe:
+                # routed blocks sow aux_loss/z_loss/overflow; under
+                # manual seq each device's router stats cover its own
+                # token block.  LM path: aux stays per-block (varying) and
+                # the same 1/sp scaling as the task loss makes the
+                # transpose psum the mean-over-blocks aux gradient.
+                # Classification path: the task loss is seq-INVARIANT
+                # (the [CLS] broadcast), and adding a seq-VARYING aux —
+                # even 0.0 × aux — would flip the objective's vma type to
+                # varying, which turns the broadcast-psum transpose from
+                # one replicated seed into sp summed seeds: every gradient
+                # upstream of the [CLS] broadcast comes out sp× too large.
+                # pmean makes aux invariant AND is the objective we want
+                # (mean over block routers); its transpose hands each
+                # block d/d aux_block = w/sp, the correct mean gradient.
+                logits, col = apply_fn(
+                    {"params": params}, x, train=True,
+                    rngs={"dropout": rng}, mutable=["intermediates"])
+                aux, z, overflow = router_losses(col["intermediates"])
+                if manual and not lm:
+                    aux = jax.lax.pmean(aux, seq_axis)
+                    z = jax.lax.pmean(z, seq_axis)
+                    overflow = jax.lax.pmean(overflow, seq_axis)
+            else:
+                logits = apply_fn({"params": params}, x, train=True,
+                                  rngs={"dropout": rng})
+                aux = z = overflow = jnp.zeros((), jnp.float32)
+            # global-batch mean: 'data' is a GSPMD axis in both paths, so
+            # the mean is global as written.  Over 'seq': classification
+            # logits are invariant ([CLS] broadcast) and the loss needs
+            # no scale; LM logits VARY (each device scores its token
+            # block), so the local mean covers 1/sp of the tokens — the
+            # 1/sp scale makes the seq psum of partial cotangents the
+            # global-mean gradient (same argument as seq_parallel.py).
+            ce = cross_entropy_onehot if (manual and lm) else cross_entropy
+            loss = ce(logits, y).mean()
+            acc = (logits.argmax(-1) == y).mean()
+            total = loss + aux_weight * aux + z_weight * z
+            scale = sp if (manual and lm) else 1
+            return total / scale, (loss, acc, total, overflow)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def accum_manual(params, x, y, rng):
+            """K-microbatch scan inside the manual-'seq' shard_map: the
+            batch dim is GSPMD-global here, so the reshape/scan is the
+            plain accumulation; scan carries must be pcast to the
+            varying-over-'seq' types the per-chunk values have (see the
+            vz flags below)."""
+            b = x.shape[0]
+            if b % K:
+                raise ValueError(
+                    f"global batch {b} not divisible by grad_accum {K}")
+            xm = x.reshape((K, b // K) + x.shape[1:])
+            ym = y.reshape((K, b // K) + y.shape[1:])
+
+            def micro(carry, chunk):
+                g_acc, a_acc, i = carry
+                xc, yc = chunk
+                (_, aux_c), g = grad_fn(params, xc, yc,
+                                        jax.random.fold_in(rng, i))
+                return (jax.tree.map(jnp.add, g_acc, g),
+                        jax.tree.map(jnp.add, a_acc, aux_c), i + 1), None
+
+            def vz(varying: bool):
+                z = jnp.zeros((), jnp.float32)
+                return (jax.lax.pcast(z, (seq_axis,), to="varying")
+                        if varying else z)
+
+            # carry vma types mirror the per-chunk values: loss/acc/total
+            # vary iff LM (classification is [CLS]-invariant, and the moe
+            # branch pmean's its aux terms invariant there); overflow
+            # varies only for LM MoE (classification pmean's it, non-moe
+            # is a constant zero)
+            init = (jax.tree.map(jnp.zeros_like, params),
+                    (vz(lm), vz(lm), vz(lm), vz(lm and moe)),
+                    jnp.zeros((), jnp.int32))
+            (g_sum, a_sum, _), _ = jax.lax.scan(micro, init, (xm, ym))
+            return (jax.tree.map(lambda t: t / K, g_sum),
+                    jax.tree.map(lambda t: t / K, a_sum))
 
         def train_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
@@ -172,45 +258,24 @@ class CompositeEngine(Engine):
                 # a shared mask would drop the same local offsets everywhere
                 rng = jax.random.fold_in(rng, coll.axis_index(seq_axis))
 
-            def loss_fn(params):
-                if moe:
-                    # routed blocks sow aux_loss/z_loss/overflow; under
-                    # manual seq each device's router stats cover its own
-                    # token block — the same 1/sp scaling as the task loss
-                    # makes the transpose psum the mean-over-blocks aux
-                    # gradient
-                    logits, col = apply_fn(
-                        {"params": params}, x, train=True,
-                        rngs={"dropout": rng}, mutable=["intermediates"])
-                    aux, z, overflow = router_losses(col["intermediates"])
-                else:
-                    logits = apply_fn({"params": params}, x, train=True,
-                                      rngs={"dropout": rng})
-                    aux = z = overflow = jnp.zeros((), jnp.float32)
-                # global-batch mean: 'data' is a GSPMD axis in both paths, so
-                # the mean is global as written.  Over 'seq': classification
-                # logits are invariant ([CLS] broadcast) and the loss needs
-                # no scale; LM logits VARY (each device scores its token
-                # block), so the local mean covers 1/sp of the tokens — the
-                # 1/sp scale makes the seq psum of partial cotangents the
-                # global-mean gradient (same argument as seq_parallel.py).
-                ce = cross_entropy_onehot if (manual and lm) else cross_entropy
-                loss = ce(logits, y).mean()
-                acc = (logits.argmax(-1) == y).mean()
-                total = loss + aux_weight * aux + z_weight * z
-                scale = sp if (manual and lm) else 1
-                return total / scale, (loss, acc, total, overflow)
-
-            (_, (loss, acc, total, overflow)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state.params)
+            if K == 1:
+                ((_, (loss, acc, total, overflow)),
+                 grads) = grad_fn(state.params, x, y, rng)
+            elif manual:
+                grads, (loss, acc, total, overflow) = accum_manual(
+                    state.params, x, y, rng)
+            else:
+                # pure-GSPMD path: the shared accumulator (aux pytree)
+                grads, _, (loss, acc, total, overflow) = gspmd_grad_accum(
+                    grad_fn, state.params, x, y, rng, K, mesh=self.mesh)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             if manual and lm:  # per-seq-block values → report global means
                 loss = jax.lax.pmean(loss, seq_axis)
                 acc = jax.lax.pmean(acc, seq_axis)
-            if manual and moe:  # router stats are per-seq-block too
-                total = jax.lax.pmean(total, seq_axis)
-                overflow = jax.lax.pmean(overflow, seq_axis)
+                if moe:  # router stats are per-seq-block too
+                    total = jax.lax.pmean(total, seq_axis)
+                    overflow = jax.lax.pmean(overflow, seq_axis)
             metrics = {"loss": loss, "accuracy": acc}
             if moe:
                 metrics["total_loss"] = total

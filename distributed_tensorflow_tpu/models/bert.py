@@ -110,6 +110,12 @@ class SelfAttention(nn.Module):
 
 
 class TransformerLayer(nn.Module):
+    """Post-LN encoder layer.  ``moe_experts > 0`` swaps the dense FFN for
+    a routed MoE layer over the layer's tokens (models/moe.py MoELayer,
+    same contract as models/gpt.py GPTBlock: router diagnostics sow into
+    ``intermediates``; under seq parallelism each seq device routes its
+    own token block to the 'expert'-sharded experts)."""
+
     hidden: int = 128
     heads: int = 2
     ffn: int = 512
@@ -118,6 +124,10 @@ class TransformerLayer(nn.Module):
     seq_axis: str = "seq"
     partition_model: bool = False
     dtype: jnp.dtype = jnp.float32
+    moe_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    partition_experts: bool = False
 
     @nn.compact
     def __call__(self, x, pad_mask, train: bool = False):
@@ -126,19 +136,28 @@ class TransformerLayer(nn.Module):
                           self.seq_axis, self.dropout_rate, tp,
                           self.dtype)(x, pad_mask, train)
         x = nn.LayerNorm(dtype=self.dtype)(x + y)
-        # Megatron FFN: column-parallel expand, row-parallel contract — the
-        # (B, L, ffn) activation never leaves its model shard
-        y = nn.Dense(
-            self.ffn, dtype=self.dtype,
-            kernel_init=_part(nn.initializers.lecun_normal(),
-                              (None, meshlib.MODEL_AXIS), tp),
-            bias_init=_part(nn.initializers.zeros_init(),
-                            (meshlib.MODEL_AXIS,), tp))(x)
-        y = nn.gelu(y)
-        y = nn.Dense(
-            self.hidden, dtype=self.dtype,
-            kernel_init=_part(nn.initializers.lecun_normal(),
-                              (meshlib.MODEL_AXIS, None), tp))(y)
+        if self.moe_experts > 0:
+            from distributed_tensorflow_tpu.models.moe import moe_ffn
+
+            y = moe_ffn(x, hidden=self.ffn, moe_experts=self.moe_experts,
+                        moe_top_k=self.moe_top_k,
+                        moe_capacity_factor=self.moe_capacity_factor,
+                        partition_experts=self.partition_experts,
+                        partition_model=tp, dtype=self.dtype)
+        else:
+            # Megatron FFN: column-parallel expand, row-parallel contract —
+            # the (B, L, ffn) activation never leaves its model shard
+            y = nn.Dense(
+                self.ffn, dtype=self.dtype,
+                kernel_init=_part(nn.initializers.lecun_normal(),
+                                  (None, meshlib.MODEL_AXIS), tp),
+                bias_init=_part(nn.initializers.zeros_init(),
+                                (meshlib.MODEL_AXIS,), tp))(x)
+            y = nn.gelu(y)
+            y = nn.Dense(
+                self.hidden, dtype=self.dtype,
+                kernel_init=_part(nn.initializers.lecun_normal(),
+                                  (meshlib.MODEL_AXIS, None), tp))(y)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return nn.LayerNorm(dtype=self.dtype)(x + y)
 
@@ -196,6 +215,11 @@ class BertTinyClassifier(nn.Module):
     partition_model: bool = False
     remat: bool = False          # activation checkpointing per encoder
                                  # layer (see models/gpt.py GPTLM.remat)
+    moe_experts: int = 0         # >0: every layer's FFN is a routed MoE
+                                 # (models/moe.py; see GPTLM.moe_experts)
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    partition_experts: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -224,13 +248,19 @@ class BertTinyClassifier(nn.Module):
         # nn.remat renames the class, and flax derives param paths + init
         # RNG from the path, so without the pin remat=True would draw
         # different params under different tree paths (see models/gpt.py).
+        if self.remat and self.moe_experts:
+            raise ValueError(
+                "remat + MoE layers is unsupported: the router's sown "
+                "intermediates would be re-sown during backward recompute "
+                "(see models/gpt.py)")
         layer_cls = (nn.remat(TransformerLayer, static_argnums=(3,))
                      if self.remat else TransformerLayer)
         for i in range(self.layers):
             x = layer_cls(self.hidden, self.heads, self.ffn,
                           self.dropout_rate, self.attention_impl,
                           self.seq_axis, self.partition_model,
-                          self.dtype,
+                          self.dtype, self.moe_experts, self.moe_top_k,
+                          self.moe_capacity_factor, self.partition_experts,
                           name=f"TransformerLayer_{i}")(x, pad_mask, train)
         cls = x[:, 0]  # [CLS]: global position 0
         if seq_parallel:

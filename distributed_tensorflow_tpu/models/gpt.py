@@ -243,16 +243,13 @@ class GPTBlock(nn.Module):
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
         if self.moe_experts > 0:
-            from distributed_tensorflow_tpu.models.moe import MoELayer
+            from distributed_tensorflow_tpu.models.moe import moe_ffn
 
-            b, l, d = y.shape
-            y = MoELayer(num_experts=self.moe_experts, hidden=self.ffn,
-                         capacity_factor=self.moe_capacity_factor,
-                         router_top_k=self.moe_top_k,
-                         partition_experts=self.partition_experts,
-                         partition_model=tp and self.partition_experts,
-                         dtype=self.dtype)(y.reshape(b * l, d))
-            y = y.reshape(b, l, d)
+            y = moe_ffn(y, hidden=self.ffn, moe_experts=self.moe_experts,
+                        moe_top_k=self.moe_top_k,
+                        moe_capacity_factor=self.moe_capacity_factor,
+                        partition_experts=self.partition_experts,
+                        partition_model=tp, dtype=self.dtype)
         else:
             # Megatron FFN: column-parallel up, row-parallel down
             y = nn.Dense(
@@ -504,11 +501,13 @@ def generate(model: GPTLM, params, prompt, max_new_tokens: int, *,
         # params committed to this mesh (TP TrainState) are used in place;
         # anything else replicates onto the mesh
         repl = NamedSharding(mesh, P())
+        target_devices = mesh.devices.tolist()
 
         def place(t):
             sh = getattr(t, "sharding", None)
-            if (isinstance(sh, NamedSharding)
-                    and sh.mesh.devices.tolist() == mesh.devices.tolist()):
+            if isinstance(sh, NamedSharding) and (
+                    sh.mesh is mesh
+                    or sh.mesh.devices.tolist() == target_devices):
                 return t
             return jax.device_put(t, repl)
 

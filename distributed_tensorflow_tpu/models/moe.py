@@ -158,6 +158,30 @@ class MoELayer(nn.Module):
         return y
 
 
+def moe_ffn(x, *, hidden: int, moe_experts: int, moe_top_k: int,
+            moe_capacity_factor: float, partition_experts: bool,
+            partition_model: bool, dtype) -> jnp.ndarray:
+    """Routed-FFN swap for a transformer block: (B, L, D) tokens →
+    (B, L, D) through a MoELayer over the flattened B·L tokens.
+
+    The single definition of the transformer-block MoE dispatch, shared
+    by GPTBlock (models/gpt.py) and TransformerLayer (models/bert.py) so
+    the two families cannot diverge.  Must be called inside the caller's
+    ``@nn.compact`` ``__call__`` — the MoELayer submodule is created in
+    the caller's flax scope (auto-named ``MoELayer_i`` there).
+    ``partition_model`` only takes effect together with
+    ``partition_experts`` (the GShard 2-D layout needs the expert axis
+    first)."""
+    b, l, d = x.shape
+    y = MoELayer(num_experts=moe_experts, hidden=hidden,
+                 capacity_factor=moe_capacity_factor,
+                 router_top_k=moe_top_k,
+                 partition_experts=partition_experts,
+                 partition_model=partition_model and partition_experts,
+                 dtype=dtype)(x.reshape(b * l, d))
+    return y.reshape(b, l, d)
+
+
 class MoEClassifier(nn.Module):
     """embed → (residual MoE layer) × depth → head, over flattened inputs.
 

@@ -135,10 +135,17 @@ class _Experiment:
 
 
 def _setup(config: ExperimentConfig) -> _Experiment:
-    if config.router_z_weight and config.expert_parallel <= 1:
+    # the z-loss is applied by the MoE-aware engines: the -ep paths, and
+    # the tp×sp composite when the model carries MoE blocks
+    # (--model-arg moe_experts=N)
+    composite_moe = (config.tensor_parallel > 1 and config.seq_parallel > 1
+                     and bool((config.model_args or {}).get("moe_experts")))
+    if (config.router_z_weight and config.expert_parallel <= 1
+            and not composite_moe):
         raise ValueError(
-            "--router-z-weight is applied by the expert-parallel engine; "
-            "without --expert-parallel > 1 it would be silently ignored")
+            "--router-z-weight is applied by the MoE-aware engines; "
+            "without --expert-parallel > 1 (or a tp×sp composite with "
+            "--model-arg moe_experts=N) it would be silently ignored")
     multi = [f for f in ("seq_parallel", "tensor_parallel", "pipeline_parallel",
                          "expert_parallel")
              if getattr(config, f) > 1]
@@ -438,12 +445,11 @@ def _split_mesh(config: ExperimentConfig, factor: int, factor_name: str,
             f"got engine='{config.engine}'{why}")
     if config.grad_accum > 1 and not grad_accum_ok:
         raise ValueError(
-            f"grad_accum composes with the sync/allreduce/fsdp data-parallel "
-            f"engines, tensor_parallel / fsdp×tp (GSPMD accumulation), "
-            f"seq_parallel (per-shard scan) and expert_parallel (microbatched "
-            f"dispatch), not with {factor_name}: the pipeline modes already "
-            f"microbatch (--microbatches), and the composite modes "
-            f"(tp×sp, ep×sp, ep×tp×sp) don't accumulate yet")
+            f"grad_accum composes with every non-pipeline mode "
+            f"(sync/allreduce/fsdp, tensor_parallel, fsdp×tp, seq_parallel, "
+            f"expert_parallel, and the tp×sp / ep×sp / ep×tp×sp "
+            f"composites), not with {factor_name}: the pipeline schedules "
+            f"already microbatch — size their chunks with --microbatches")
     factors = [(factor, second_axis), *more]
     total = config.n_devices or len(_jax.devices())
     prod = 1
@@ -691,15 +697,24 @@ def _setup_composite(config: ExperimentConfig) -> _Experiment:
 
     mesh, dp = _split_mesh(config, config.tensor_parallel,
                            "tensor_parallel×seq_parallel", meshlib.MODEL_AXIS,
-                           (config.seq_parallel, meshlib.SEQ_AXIS))
+                           (config.seq_parallel, meshlib.SEQ_AXIS),
+                           grad_accum_ok=True)
     train_ds, test_ds = _load_data(config)
     model = _sequence_model(config, train_ds, "tensor_parallel×seq_parallel",
                             partition_model=True,
                             attention_impl=config.attention_impl)
+    _check_accum_divides(config, _global_batch(config, dp),
+                         "tensor_parallel×seq_parallel")
+    # a --model-arg moe_experts=N model makes the composite MoE-aware, so
+    # the balance-loss weights must reach the engine here too (not only on
+    # the -ep paths) — otherwise --aux-weight would be silently ignored
     engine = CompositeEngine(
         model, mesh=mesh, learning_rate=config.learning_rate,
         optimizer=_make_optimizer(config, train_ds,
-                                  _global_batch(config, dp)))
+                                  _global_batch(config, dp)),
+        aux_weight=config.aux_weight,
+        router_z_weight=config.router_z_weight,
+        grad_accum=config.grad_accum)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
 
@@ -907,11 +922,12 @@ def _setup_expert_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
 
     mode = ("expert_parallel×tensor_parallel×seq_parallel" if tp > 1
             else "expert_parallel×seq_parallel")
-    if config.model not in _LM_MODELS:
+    if config.model not in _SEQUENCE_MODELS:
         raise ValueError(
-            f"{mode} routes the GPT decoder's FFN blocks (moe_experts); "
-            f"got --model {config.model} — use --model gpt with "
-            f"--dataset lm_synth")
+            f"{mode} routes a transformer's FFN blocks (moe_experts on "
+            f"models/gpt.py or models/bert.py); got --model {config.model} "
+            f"— use --model gpt (--dataset lm_synth) or --model bert_tiny "
+            f"(--dataset glue_synth)")
     if config.attention_impl == "flash":
         raise ValueError(
             "--attention flash is the single-device kernel; with "
@@ -923,7 +939,8 @@ def _setup_expert_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
     extra = [(tp, meshlib.MODEL_AXIS)] if tp > 1 else []
     mesh, dp = _split_mesh(config, config.expert_parallel, mode,
                            meshlib.EXPERT_AXIS,
-                           (config.seq_parallel, meshlib.SEQ_AXIS), *extra)
+                           (config.seq_parallel, meshlib.SEQ_AXIS), *extra,
+                           grad_accum_ok=True)
     train_ds, test_ds = _load_data(config)
     model = _sequence_model(
         config, train_ds, mode,
@@ -932,12 +949,14 @@ def _setup_expert_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
         moe_top_k=config.router_top_k,
         partition_experts=True,
         partition_model=tp > 1)
+    _check_accum_divides(config, _global_batch(config, dp), mode)
     engine = CompositeEngine(
         model, mesh=mesh, learning_rate=config.learning_rate,
         optimizer=_make_optimizer(config, train_ds,
                                   _global_batch(config, dp)),
         aux_weight=config.aux_weight,
-        router_z_weight=config.router_z_weight)
+        router_z_weight=config.router_z_weight,
+        grad_accum=config.grad_accum)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp))
 
