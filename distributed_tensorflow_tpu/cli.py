@@ -107,9 +107,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup-steps", type=int, default=0,
                    help="linear LR warmup steps (0 disables)")
     p.add_argument("--grad-accum", type=int, default=1,
-                   help="microbatches accumulated per optimizer step "
-                        "(sync/allreduce engines): ~K× less activation "
-                        "memory at identical math")
+                   help="microbatches accumulated per optimizer step: ~K× "
+                        "less activation memory at identical math.  "
+                        "Composes with sync/allreduce/fsdp, -tp, fsdp×tp, "
+                        "-sp, -ep, and the tp×sp/ep×sp composites; the "
+                        "pipeline modes microbatch via --microbatches, and "
+                        "the async/gossip engines reject it (their local "
+                        "steps already decouple optimizer cadence)")
     p.add_argument("--weight-decay", type=float, default=0.0,
                    help=">0: AdamW decoupled weight decay")
     p.add_argument("--clip-norm", type=float, default=0.0,

@@ -114,13 +114,24 @@ def gspmd_grad_accum(grad_fn, params, x, y, rng, K: int, mesh=None,
     xm = x.reshape((K, x.shape[0] // K) + x.shape[1:])
     ym = y.reshape((K, y.shape[0] // K) + y.shape[1:])
     if mesh is not None:
-        def pin(t):
-            spec = P(None, batch_axes,
-                     *([None] * (t.ndim - 2)))
-            return jax.lax.with_sharding_constraint(
-                t, NamedSharding(mesh, spec))
+        axes = batch_axes if isinstance(batch_axes, tuple) else (batch_axes,)
+        n_batch = 1
+        for a in axes:
+            n_batch *= mesh.shape[a]
+        # pin ONLY when each chunk's batch divides the batch-axes size:
+        # forcing an uneven shard pads the per-device batch, and the padded
+        # rows' embedding-gather cotangents scatter-add garbage into real
+        # vocab rows (caught by test_tp_grad_accum_matches_k1 at K=4 on a
+        # data=4 mesh — chunk batch 2).  When indivisible, sharding
+        # propagation's own choice is left alone.
+        if (x.shape[0] // K) % n_batch == 0:
+            def pin(t):
+                spec = P(None, batch_axes,
+                         *([None] * (t.ndim - 2)))
+                return jax.lax.with_sharding_constraint(
+                    t, NamedSharding(mesh, spec))
 
-        xm, ym = pin(xm), pin(ym)
+            xm, ym = pin(xm), pin(ym)
 
     def micro(carry, chunk):
         g_acc, l_acc, a_acc, i = carry

@@ -445,11 +445,11 @@ def _split_mesh(config: ExperimentConfig, factor: int, factor_name: str,
             f"got engine='{config.engine}'{why}")
     if config.grad_accum > 1 and not grad_accum_ok:
         raise ValueError(
-            f"grad_accum composes with every non-pipeline mode "
-            f"(sync/allreduce/fsdp, tensor_parallel, fsdp×tp, seq_parallel, "
-            f"expert_parallel, and the tp×sp / ep×sp / ep×tp×sp "
-            f"composites), not with {factor_name}: the pipeline schedules "
-            f"already microbatch — size their chunks with --microbatches")
+            f"grad_accum composes with sync/allreduce/fsdp, tensor_parallel, "
+            f"fsdp×tp, seq_parallel, expert_parallel, and the tp×sp / ep×sp "
+            f"/ ep×tp×sp composites, not with {factor_name}: the pipeline "
+            f"schedules already microbatch — size their chunks with "
+            f"--microbatches")
     factors = [(factor, second_axis), *more]
     total = config.n_devices or len(_jax.devices())
     prod = 1
