@@ -153,6 +153,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "the GPipe tick stash but is a documented no-op "
                         "for --pipeline-schedule 1f1b (the 1F1B stash is "
                         "already bounded at S slots)")
+    p.add_argument("--sample", type=int, default=0, metavar="N",
+                   help="after training a GPT LM, greedy-decode N tokens "
+                        "per prompt from the final params (KV-cache "
+                        "sampler; multi-device over the run's mesh) and "
+                        "record prompts+continuations in the summary")
+    p.add_argument("--sample-prompt-len", type=int, default=8,
+                   help="prompt tokens taken from the test split per "
+                        "sampled row (--sample)")
     p.add_argument("--model-arg", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="extra model constructor field (repeatable), e.g. "
@@ -341,6 +349,8 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         watchdog_abort=args.watchdog_abort,
         nan_guard=not args.no_nan_guard,
         max_restarts=args.max_restarts,
+        sample_tokens=args.sample,
+        sample_prompt_len=args.sample_prompt_len,
     )
     summary = run(config)  # run() itself wraps recovery when max_restarts>0
     print(json.dumps(summary))

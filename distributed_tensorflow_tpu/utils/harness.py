@@ -120,6 +120,13 @@ class ExperimentConfig:
     nan_guard: bool = True                 # divergence check at log cadence
     max_restarts: int = 0                  # >0: checkpoint-resume crash
                                            # recovery (run_with_recovery)
+    sample_tokens: int = 0                 # >0: after training an LM, decode
+                                           # this many tokens per prompt from
+                                           # the final params (KV-cache
+                                           # sampler, models/gpt.py generate)
+                                           # and record them in the summary
+    sample_prompt_len: int = 8             # prompt tokens taken from the
+                                           # test split per sampled row
 
 
 @dataclasses.dataclass
@@ -146,6 +153,18 @@ def _setup(config: ExperimentConfig) -> _Experiment:
             "--router-z-weight is applied by the MoE-aware engines; "
             "without --expert-parallel > 1 (or a tp×sp composite with "
             "--model-arg moe_experts=N) it would be silently ignored")
+    if config.sample_tokens:
+        if config.pipeline_parallel > 1:
+            raise ValueError(
+                "--sample needs the whole model's params in one tree; the "
+                "pipeline engines stack params per 'pipe' stage (the "
+                "embedding lives only in stage 0), so post-train sampling "
+                "is unavailable under --pipeline-parallel — checkpoint and "
+                "sample in a non-pipeline run instead")
+        if config.model_fn is None and config.model not in _LM_MODELS:
+            raise ValueError(
+                f"--sample decodes autoregressively and needs a causal LM "
+                f"({'/'.join(_LM_MODELS)}), got --model {config.model}")
     multi = [f for f in ("seq_parallel", "tensor_parallel", "pipeline_parallel",
                          "expert_parallel")
              if getattr(config, f) > 1]
@@ -984,6 +1003,8 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     ex = _setup(config)
     n, train_ds, test_ds = ex.n, ex.train_ds, ex.test_ds
     global_batch = ex.global_batch
+    if config.sample_tokens:
+        _validate_sampling(config, ex, test_ds)
 
     # in a multi-host pod only process 0 reports — N processes each emitting
     # the start/done/results triple would corrupt an external supervisor's
@@ -1121,10 +1142,77 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         monitor = getattr(ex.engine, "overflow_monitor", None)
         if monitor is not None:
             summary.update(monitor.report())
+        if config.sample_tokens:
+            summary.update(_sample_from_state(config, ex, trainer.state,
+                                              test_ds))
         sink.emit("summary", **summary)
         return summary
     finally:
         sink.close()
+
+
+def _validate_sampling(config: ExperimentConfig, ex: _Experiment,
+                       test_ds) -> None:
+    """Every deterministically-knowable --sample failure is raised BEFORE
+    training: a post-train ValueError would waste the whole run — and
+    under --max-restarts it would be caught by run_with_recovery as a
+    restartable crash and re-train up to max_restarts more times, failing
+    identically after each."""
+    from distributed_tensorflow_tpu.models.gpt import GPTLM
+
+    if config.sample_tokens < 0:
+        raise ValueError(
+            f"--sample must be positive, got {config.sample_tokens}")
+    model = ex.engine.model
+    if not isinstance(model, GPTLM):
+        raise ValueError(
+            f"--sample requires the GPT causal LM; the resolved model is "
+            f"{type(model).__name__}")
+    plen = config.sample_prompt_len
+    if plen < 1 or plen > test_ds.x.shape[1]:
+        raise ValueError(
+            f"--sample-prompt-len {plen} outside the test sequences' "
+            f"length {test_ds.x.shape[1]}")
+    if plen + config.sample_tokens > model.max_len:
+        raise ValueError(
+            f"--sample-prompt-len {plen} + --sample {config.sample_tokens} "
+            f"exceeds the model's cache capacity max_len={model.max_len}")
+    n_prompts = ex.mesh.shape.get(meshlib.DATA_AXIS, 1)
+    if len(test_ds.x) < n_prompts:
+        raise ValueError(
+            f"--sample takes one prompt per data shard ({n_prompts}), but "
+            f"the test split has only {len(test_ds.x)} rows")
+
+
+def _sample_from_state(config: ExperimentConfig, ex: _Experiment, state,
+                       test_ds) -> dict[str, Any]:
+    """--sample N: greedy-decode N tokens per prompt from the trained
+    params (models/gpt.py ``generate`` — KV-cache sampler; multi-device
+    when the run's mesh has >1 device: batch over 'data', Megatron layout
+    kept under a 'model' axis).
+
+    Prompts are the first ``sample_prompt_len`` tokens of one test row per
+    data-axis shard (divisibility with the 'data' axis by construction).
+    Greedy, so the recorded continuation is a deterministic function of
+    the final params — reproducible evidence of what the model learned,
+    not a dice roll.  Engines whose state stacks per-device copies
+    (async/gossip) are averaged first via their ``eval_params`` — the same
+    consensus model their evaluation uses.  Arguments were validated
+    pre-train (_validate_sampling)."""
+    from distributed_tensorflow_tpu.models.gpt import generate
+
+    get_params = getattr(ex.engine, "eval_params", None)
+    params = (get_params(state) if get_params is not None else state.params)
+    n_prompts = ex.mesh.shape.get(meshlib.DATA_AXIS, 1)
+    prompts = np.asarray(test_ds.x[:n_prompts, :config.sample_prompt_len],
+                         dtype=np.int32)
+    mesh = ex.mesh if ex.mesh.devices.size > 1 else None
+    toks = np.asarray(generate(ex.engine.model, params, prompts,
+                               config.sample_tokens, greedy=True, mesh=mesh))
+    return {
+        "sample_prompts": prompts.tolist(),
+        "samples": toks.tolist(),
+    }
 
 
 def steps_to_accuracy(

@@ -735,3 +735,52 @@ def test_gpt_seq_parallel_grad_accum_parity(lm_data):
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-5),
         out[1][1], out[2][1])
+
+
+# ---------------------------------------------------------------- --sample
+
+
+def test_harness_sample_after_training():
+    """--sample N: the summary carries greedy continuations decoded from
+    the trained params (deterministic per seed), shaped (data_shards, N),
+    with token ids inside the vocab."""
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    out = run(ExperimentConfig(
+        model="gpt", dataset="lm_synth", engine="sync", n_devices=8,
+        batch_size=4, epochs=1, log_every=0, sample_tokens=6,
+        sample_prompt_len=4,
+        model_args={"hidden": 32, "layers": 1, "heads": 2, "ffn": 64}))
+    samples = np.asarray(out["samples"])
+    prompts = np.asarray(out["sample_prompts"])
+    assert samples.shape == (8, 6) and prompts.shape == (8, 4)
+    # lm_synth's default vocab is 128 (data/loaders.py load_lm_dataset)
+    assert (samples >= 0).all() and (samples < 128).all()
+
+
+def test_harness_sample_validation():
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    with pytest.raises(ValueError, match="sample"):
+        run(ExperimentConfig(model="gpt", dataset="lm_synth",
+                             pipeline_parallel=4, sample_tokens=4,
+                             n_devices=8))
+    with pytest.raises(ValueError, match="causal LM"):
+        run(ExperimentConfig(model="mlp", dataset="synthetic",
+                             sample_tokens=4, n_devices=8))
+    # deterministically-knowable failures raise BEFORE training: a
+    # post-train raise would waste the run (and loop under --max-restarts)
+    base = dict(model="gpt", dataset="lm_synth", engine="sync", n_devices=8,
+                model_args={"hidden": 32, "layers": 1, "heads": 2,
+                            "ffn": 64})
+    with pytest.raises(ValueError, match="positive"):
+        run(ExperimentConfig(sample_tokens=-4, **base))
+    with pytest.raises(ValueError, match="sample-prompt-len"):
+        run(ExperimentConfig(sample_tokens=4, sample_prompt_len=500, **base))
+    with pytest.raises(ValueError, match="cache capacity"):
+        run(ExperimentConfig(sample_tokens=4, sample_prompt_len=128,
+                             **{**base, "model_args": {
+                                 "hidden": 32, "layers": 1, "heads": 2,
+                                 "ffn": 64, "max_len": 128}}))
