@@ -545,26 +545,72 @@ def gpt_train_flops_per_token(hidden: int, layers: int, ffn: int,
     return 3.0 * fwd
 
 
+def _measure_gpt_variant(label: str, tag: str, mesh, x, y,
+                         tokens_per_step: int, **model_kwargs) -> list:
+    """One differenced-scan throughput measurement of a GPT variant under
+    the sync engine — THE shared protocol for the --lm and --moe modes (a
+    protocol change edits exactly this function).  Returns the list of
+    per-rep tokens/sec rates; progress goes to stderr (compiles of models
+    this size take minutes through a tunnel; a silent multi-minute run is
+    indistinguishable from a hang)."""
+    import sys
+
+    import jax
+
+    from distributed_tensorflow_tpu.engines import SyncEngine
+    from distributed_tensorflow_tpu.models import create_model
+
+    def note(msg):
+        print(f"[bench {tag}] {msg}", file=sys.stderr, flush=True)
+
+    n = mesh.shape["data"]
+    t_build = time.perf_counter()
+    model = create_model("gpt", dropout_rate=0.0, **model_kwargs)
+    eng = SyncEngine(model, mesh=mesh)
+    state = eng.init_state(jax.random.key(0), x[:n])
+    xs, ys = eng.shard_batch(x, y)
+    state, _ = eng.step(state, xs, ys)  # compile the single step
+    _sync(state)
+    note(f"{label}: step compiled in {time.perf_counter() - t_build:.0f}s")
+
+    def scan_body(st, _):
+        st, _m = eng.step(st, xs, ys)
+        return st, None
+
+    short, long = 3, 13
+    runs = {k: jax.jit(lambda st, k=k: jax.lax.scan(
+        scan_body, st, None, length=k)[0]) for k in (short, long)}
+    for k, run in runs.items():
+        t0 = time.perf_counter()
+        state = run(state)
+        _sync(state)
+        note(f"{label}: scan({k}) compiled+ran in "
+             f"{time.perf_counter() - t0:.0f}s")
+    rates = []
+    for rep in range(REPEATS):
+        t = {}
+        for k, run in runs.items():
+            t0 = time.perf_counter()
+            state = run(state)
+            _sync(state)
+            t[k] = time.perf_counter() - t0
+        per_step = (t[long] - t[short]) / (long - short)
+        rates.append(tokens_per_step / per_step)
+        note(f"{label}: rep {rep}: {rates[-1] / 1e3:.1f}k tokens/s")
+    return rates
+
+
 def bench_lm(batch: int = 8, seq_len: int = 1024, vocab: int = 16384,
              hidden: int = 512, layers: int = 8, heads: int = 8,
              ffn: int = 2048) -> None:
     """Training throughput (tokens/sec/chip) + MFU of a GPT-2-small-ish
     decoder LM in bf16, flash vs dense attention — the transformer
     counterpart of the default CNN bench, same differenced-scan-window
-    protocol.  Progress goes to stderr (compiles of a model this size take
-    minutes through a tunnel; a silent multi-minute run is
-    indistinguishable from a hang)."""
-    import sys
-
+    protocol (_measure_gpt_variant)."""
     import jax
     import jax.numpy as jnp
 
-    from distributed_tensorflow_tpu.engines import SyncEngine
-    from distributed_tensorflow_tpu.models import create_model
     from distributed_tensorflow_tpu.parallel import mesh as meshlib
-
-    def note(msg):
-        print(f"[bench --lm] {msg}", file=sys.stderr, flush=True)
 
     mesh = meshlib.create_mesh()
     n = mesh.shape[meshlib.DATA_AXIS]
@@ -580,50 +626,17 @@ def bench_lm(batch: int = 8, seq_len: int = 1024, vocab: int = 16384,
 
     rows = {}
     for impl in ("dense", "flash"):
-        t_impl = time.perf_counter()
-        model = create_model(
-            "gpt", num_classes=vocab, hidden=hidden, layers=layers,
-            heads=heads, ffn=ffn, max_len=seq_len, dropout_rate=0.0,
-            attention_impl=impl, dtype=jnp.bfloat16)
-        eng = SyncEngine(model, mesh=mesh)
-        state = eng.init_state(jax.random.key(0), x[:n])
-        xs, ys = eng.shard_batch(x, y)
-        state, m = eng.step(state, xs, ys)  # compile the single step
-        _sync(state)
-        note(f"{impl}: step compiled in {time.perf_counter() - t_impl:.0f}s")
-
-        def scan_body(st, _):
-            st, _m = eng.step(st, xs, ys)
-            return st, None
-
-        short, long = 3, 13
-        runs = {k: jax.jit(lambda st, k=k: jax.lax.scan(
-            scan_body, st, None, length=k)[0]) for k in (short, long)}
-        for k, run in runs.items():
-            t0 = time.perf_counter()
-            state = run(state)
-            _sync(state)
-            note(f"{impl}: scan({k}) compiled+ran in "
-                 f"{time.perf_counter() - t0:.0f}s")
-        rates = []
-        for rep in range(REPEATS):
-            t = {}
-            for k, run in runs.items():
-                t0 = time.perf_counter()
-                state = run(state)
-                _sync(state)
-                t[k] = time.perf_counter() - t0
-            per_step = (t[long] - t[short]) / (long - short)
-            rates.append(tokens_per_step / per_step)
-            note(f"{impl}: rep {rep}: "
-                 f"{rates[-1] / 1e3:.1f}k tokens/s")
+        rates = _measure_gpt_variant(
+            impl, "--lm", mesh, x, y, tokens_per_step,
+            num_classes=vocab, hidden=hidden, layers=layers, heads=heads,
+            ffn=ffn, max_len=seq_len, attention_impl=impl,
+            dtype=jnp.bfloat16)
         med, spread = _median_spread(rates)
         rows[impl] = {
             "tokens_per_sec_per_chip": round(med / n, 1),
             "spread": round(spread, 4),
             "mfu": (round(med * flops_tok / (n * peak), 4) if peak else None),
         }
-        del state, eng  # free HBM before the next impl compiles
 
     print(json.dumps({
         "metric": "gpt_lm_sync_tokens_per_sec_per_chip",
@@ -641,10 +654,68 @@ def bench_lm(batch: int = 8, seq_len: int = 1024, vocab: int = 16384,
     }))
 
 
+def bench_moe(batch: int = 8, seq_len: int = 1024, vocab: int = 16384,
+              hidden: int = 512, layers: int = 8, heads: int = 8,
+              ffn: int = 2048, experts: int = 8) -> None:
+    """MoE-FFN vs dense-FFN GPT training throughput (tokens/sec/chip) —
+    the on-chip cost of the GShard dense-dispatch formulation
+    (models/moe.py): both models have IDENTICAL active FLOPs per token
+    (top-1 routing through one ffn-wide expert vs one dense ffn), so the
+    reported ratio isolates router + dispatch/combine einsum overhead.
+    Single-chip: all experts resident (the multi-chip expert all-to-all is
+    exercised by the dryrun's ep modes, not measurable on one device).
+    Same differenced-scan protocol as --lm (_measure_gpt_variant)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+    mesh = meshlib.create_mesh()
+    n = mesh.shape[meshlib.DATA_AXIS]
+    device_kind = jax.devices()[0].device_kind
+    tokens_per_step = batch * n * seq_len
+
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, vocab, (batch * n, seq_len + 1))
+    x = tok[:, :-1].astype(np.int32)
+    y = tok[:, 1:].astype(np.int32)
+
+    rows = {}
+    for kind, extra in (("dense", {}),
+                        ("moe", {"moe_experts": experts})):
+        rates = _measure_gpt_variant(
+            kind, "--moe", mesh, x, y, tokens_per_step,
+            num_classes=vocab, hidden=hidden, layers=layers, heads=heads,
+            ffn=ffn, max_len=seq_len, attention_impl="flash",
+            dtype=jnp.bfloat16, **extra)
+        med, spread = _median_spread(rates)
+        rows[kind] = {
+            "tokens_per_sec_per_chip": round(med / n, 1),
+            "spread": round(spread, 4),
+        }
+
+    print(json.dumps({
+        "metric": "gpt_moe_sync_tokens_per_sec_per_chip",
+        "config": {"batch_per_chip": batch, "seq_len": seq_len,
+                   "vocab": vocab, "hidden": hidden, "layers": layers,
+                   "heads": heads, "ffn": ffn, "experts": experts,
+                   "router_top_k": 1, "dtype": "bfloat16",
+                   "attention": "flash"},
+        "device": device_kind,
+        "n_devices": n,
+        "synthetic": True,
+        **{f"{k}_{kk}": vv for k, v in rows.items() for kk, vv in v.items()},
+        "moe_vs_dense": round(
+            rows["moe"]["tokens_per_sec_per_chip"]
+            / rows["dense"]["tokens_per_sec_per_chip"], 3),
+    }))
+
+
 _MODE_METRICS = {
     "stream": "mnist_cnn_stream_examples_per_sec",
     "attention": "attention_fwd_bwd_step_ms",
     "lm": "gpt_lm_sync_tokens_per_sec_per_chip",
+    "moe": "gpt_moe_sync_tokens_per_sec_per_chip",
     "default": "mnist_cnn_sync_examples_per_sec_per_chip",
 }
 
@@ -657,12 +728,15 @@ def main() -> None:
                    help="flash vs dense attention on-chip microbench")
     p.add_argument("--lm", action="store_true",
                    help="GPT decoder LM training throughput + MFU (bf16)")
+    p.add_argument("--moe", action="store_true",
+                   help="MoE-FFN vs dense-FFN GPT throughput (router + "
+                        "dispatch overhead at matched active FLOPs)")
     p.add_argument("--no-probe", action="store_true",
                    help="skip the backend-availability probe (saves ~10s "
                         "when the backend is known-good)")
     args = p.parse_args()
     mode = ("stream" if args.stream else "attention" if args.attention
-            else "lm" if args.lm else "default")
+            else "lm" if args.lm else "moe" if args.moe else "default")
     metric = _MODE_METRICS[mode]
     if not args.no_probe:
         ensure_backend(metric)
@@ -673,6 +747,8 @@ def main() -> None:
             bench_attention()
         elif mode == "lm":
             bench_lm()
+        elif mode == "moe":
+            bench_moe()
         else:
             bench_throughput()
     except Exception as e:  # noqa: BLE001 — the artifact must stay parsable

@@ -62,6 +62,16 @@ class MoELayer(nn.Module):
                                     # over the 'model' axis on top of the
                                     # expert sharding (GShard's 2-D expert
                                     # layout); requires partition_experts
+    group_size: int | None = None   # GShard G×S grouped routing: tokens
+                                    # route in independent groups of S with
+                                    # per-group capacity k·cf·S/E.  The
+                                    # dispatch/combine einsums cost
+                                    # O(S·T·d) instead of O(T²·d) (E·C ∝ S,
+                                    # not T) — the lever that keeps the
+                                    # dense-dispatch formulation linear in
+                                    # tokens at transformer scale.  None or
+                                    # non-dividing = one group (exact
+                                    # original semantics).
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -71,22 +81,30 @@ class MoELayer(nn.Module):
                 f"router_top_k must be 1 or 2, got {self.router_top_k}")
         tokens, d = x.shape
         e = self.num_experts
-        # capacity scales with k (GShard): top-2 makes 2·tokens assignments,
-        # so unscaled slots would drop ≥37% even under perfectly uniform
-        # routing and the overflow metric would read ~0.4 forever
+        gs = self.group_size
+        if gs is not None and 0 < gs < tokens and tokens % gs == 0:
+            g, s = tokens // gs, gs
+        else:
+            g, s = 1, tokens
+        xg = x.reshape(g, s, d)
+        # capacity scales with k (GShard): top-2 makes 2·s assignments per
+        # group, so unscaled slots would drop ≥37% even under perfectly
+        # uniform routing and the overflow metric would read ~0.4 forever
         capacity = max(1, int(self.router_top_k * self.capacity_factor
-                              * tokens / e + 0.999999))
+                              * s / e + 0.999999))
 
         # --- router (f32) ------------------------------------------------
         gate_w = self.param("gate", nn.initializers.lecun_normal(), (d, e),
                             jnp.float32)
-        logits = x.astype(jnp.float32) @ gate_w
+        logits = jnp.einsum("gsd,de->gse", xg.astype(jnp.float32), gate_w)
         probs = jax.nn.softmax(logits, axis=-1)
-        top1 = jnp.argmax(probs, axis=-1)                       # [T]
-        mask1 = jax.nn.one_hot(top1, e, dtype=jnp.float32)      # [T, E]
+        top1 = jnp.argmax(probs, axis=-1)                       # [G, S]
+        mask1 = jax.nn.one_hot(top1, e, dtype=jnp.float32)      # [G, S, E]
 
-        # Switch aux loss: E · Σ_e (token fraction · mean router prob)
-        aux = e * jnp.sum(mask1.mean(axis=0) * probs.mean(axis=0))
+        # Switch aux loss: E · Σ_e (token fraction · mean router prob),
+        # per group, averaged over groups (one group = original formula)
+        aux = e * jnp.mean(jnp.sum(mask1.mean(axis=1) * probs.mean(axis=1),
+                                   axis=-1))
         self.sow("intermediates", "aux_loss", aux)
         # router z-loss: keeps logits from drifting to magnitudes where
         # softmax saturates and routing gradients vanish
@@ -109,18 +127,19 @@ class MoELayer(nn.Module):
             gates = [mask1 * (p1 / denom), mask2 * (p2 / denom)]
             masks = [mask1, mask2]
 
-        dispatch = jnp.zeros((tokens, e, capacity), jnp.float32)
-        combine = jnp.zeros((tokens, e, capacity), jnp.float32)
-        offset = jnp.zeros((e,), jnp.float32)  # slots claimed by earlier k
+        dispatch = jnp.zeros((g, s, e, capacity), jnp.float32)
+        combine = jnp.zeros((g, s, e, capacity), jnp.float32)
+        offset = jnp.zeros((g, e), jnp.float32)  # slots claimed by earlier k
         assigned = kept = 0.0
         for mask, gate in zip(masks, gates):
-            position = (jnp.cumsum(mask, axis=0) - 1.0) * mask + offset
+            position = ((jnp.cumsum(mask, axis=1) - 1.0) * mask
+                        + offset[:, None, :])
             keep = mask * (position < capacity)
-            offset = offset + mask.sum(axis=0)
+            offset = offset + mask.sum(axis=1)
             pos_onehot = jax.nn.one_hot(position.astype(jnp.int32), capacity,
-                                        dtype=jnp.float32)      # [T, E, C]
-            dispatch = dispatch + keep[:, :, None] * pos_onehot
-            combine = combine + keep[:, :, None] * pos_onehot * gate[:, :, None]
+                                        dtype=jnp.float32)   # [G, S, E, C]
+            dispatch = dispatch + keep[..., None] * pos_onehot
+            combine = combine + keep[..., None] * pos_onehot * gate[..., None]
             assigned = assigned + mask.sum()
             kept = kept + keep.sum()
 
@@ -149,20 +168,51 @@ class MoELayer(nn.Module):
         w1 = self.param("w1", init1, (e, d, self.hidden), jnp.float32)
         w2 = self.param("w2", init2, (e, self.hidden, d), jnp.float32)
 
-        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(self.dtype),
-                               x.astype(self.dtype))
-        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in,
+        expert_in = jnp.einsum("gsec,gsd->gecd", dispatch.astype(self.dtype),
+                               xg.astype(self.dtype))
+        h = jax.nn.relu(jnp.einsum("gecd,edh->gech", expert_in,
                                    w1.astype(self.dtype)))
-        expert_out = jnp.einsum("ech,ehd->ecd", h, w2.astype(self.dtype))
-        y = jnp.einsum("tec,ecd->td", combine.astype(self.dtype), expert_out)
-        return y
+        expert_out = jnp.einsum("gech,ehd->gecd", h, w2.astype(self.dtype))
+        y = jnp.einsum("gsec,gecd->gsd", combine.astype(self.dtype),
+                       expert_out)
+        return y.reshape(tokens, d)
+
+
+_MOE_GROUP_TARGET = 1024  # ~GShard group size: big enough that per-group
+                          # capacity statistics are stable, small enough
+                          # that the T×(E·C) dispatch einsums stay linear
+                          # in total tokens
+
+
+_MOE_GROUP_FLOOR = 256    # below this, per-group capacity k·cf·S/E gets so
+                          # small that ordinary routing imbalance inside a
+                          # group drops tokens wholesale — better one big
+                          # group (quadratic dispatch) than quality loss
+
+
+def _moe_group_size(tokens: int, target: int = _MOE_GROUP_TARGET):
+    """Largest power-of-two divisor of ``tokens`` in [floor, target]
+    (static, trace-time).  None — one group, exact original semantics —
+    when tokens already fit in ≤target, or when the only dividing
+    power-of-two would make groups smaller than the floor (e.g. 2000
+    tokens divide by 16 but not 512: tiny groups drop tokens under any
+    routing imbalance, so the quadratic one-group dispatch is the better
+    trade)."""
+    if tokens <= target:
+        return None
+    s = target
+    while s >= _MOE_GROUP_FLOOR and tokens % s:
+        s //= 2
+    return s if s >= _MOE_GROUP_FLOOR else None
 
 
 def moe_ffn(x, *, hidden: int, moe_experts: int, moe_top_k: int,
             moe_capacity_factor: float, partition_experts: bool,
             partition_model: bool, dtype) -> jnp.ndarray:
     """Routed-FFN swap for a transformer block: (B, L, D) tokens →
-    (B, L, D) through a MoELayer over the flattened B·L tokens.
+    (B, L, D) through a MoELayer over the flattened B·L tokens, routed in
+    GShard groups of ≤ _MOE_GROUP_TARGET tokens (see MoELayer.group_size —
+    keeps the dispatch einsums linear in B·L at transformer scale).
 
     The single definition of the transformer-block MoE dispatch, shared
     by GPTBlock (models/gpt.py) and TransformerLayer (models/bert.py) so
@@ -178,6 +228,7 @@ def moe_ffn(x, *, hidden: int, moe_experts: int, moe_top_k: int,
                  router_top_k=moe_top_k,
                  partition_experts=partition_experts,
                  partition_model=partition_model and partition_experts,
+                 group_size=_moe_group_size(b * l),
                  dtype=dtype)(x.reshape(b * l, d))
     return y.reshape(b, l, d)
 

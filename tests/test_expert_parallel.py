@@ -366,3 +366,40 @@ def test_expert_parallel_grad_accum_trains(mesh8):
     for _ in range(20):
         st, m = eng.step(st, xs, ys)
     assert float(m["loss"]) < float(first["loss"])
+
+
+def test_moe_grouped_routing_matches_ungrouped_when_dropfree():
+    """GShard G×S grouped routing (group_size) is a cost optimization, not
+    a math change, when capacity never binds: with capacity_factor =
+    num_experts (zero drops) the grouped forward must equal the one-group
+    forward token-for-token."""
+    layer1 = MoELayer(num_experts=4, hidden=16, capacity_factor=4.0)
+    layerg = MoELayer(num_experts=4, hidden=16, capacity_factor=4.0,
+                      group_size=8)
+    x = jax.random.normal(jax.random.key(3), (32, 8))
+    params = layer1.init(jax.random.key(0), x)["params"]
+    y1 = layer1.apply({"params": params}, x)
+    yg = layerg.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(yg),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_moe_grouped_routing_capacity_is_per_group():
+    """Capacity binds per group of S tokens (k·cf·S/E), so a group whose
+    tokens all route to one expert drops everything past its own slots —
+    even if other groups' slots are idle."""
+    layer = MoELayer(num_experts=2, hidden=8, capacity_factor=1.0,
+                     group_size=4)  # capacity = 1·1.0·4/2 = 2 per group
+    # strictly positive features so a [+50, -50] gate row routes EVERY
+    # token to expert 0 (with sign-mixed x the forcing would be
+    # sign-of-sum dependent)
+    x = jax.random.uniform(jax.random.key(5), (8, 4), minval=0.5,
+                           maxval=1.0)
+    params = layer.init(jax.random.key(0), x)["params"]
+    forced = {"gate": jnp.asarray([[50.0, -50.0]] * 4),
+              "w1": params["w1"], "w2": params["w2"]}
+    _, col = layer.apply({"params": forced}, x,
+                         mutable=["intermediates"])
+    # 8 assignments, 2 groups × 2 slots kept → overflow = 1 - 4/8 = 0.5
+    overflow = float(col["intermediates"]["overflow"][0])
+    assert overflow == pytest.approx(0.5)
