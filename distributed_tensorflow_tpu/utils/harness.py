@@ -131,7 +131,13 @@ class ExperimentConfig:
 
 @dataclasses.dataclass
 class _Experiment:
-    """Resolved experiment: mesh, data, model, engine, global batch."""
+    """Resolved experiment: mesh, data, model, engine, global batch.
+
+    ``name`` is the summary's engine label, set by the _setup_* function
+    that chose the engine — the ONE place that knows which mode resolved
+    (run() used to re-derive it from the config flags in a parallel
+    if/elif ladder, which drifted: ep×sp runs were reported as
+    'seq_parallel[ring]' until round 5)."""
 
     mesh: Any
     n: int
@@ -139,6 +145,7 @@ class _Experiment:
     test_ds: Any
     engine: Any
     global_batch: int
+    name: str
 
 
 def _setup(config: ExperimentConfig) -> _Experiment:
@@ -253,7 +260,8 @@ def _setup(config: ExperimentConfig) -> _Experiment:
         engine_kw["grad_accum"] = config.grad_accum
     engine = create_engine(config.engine, model, **engine_kw)
     return _Experiment(mesh=mesh, n=n, train_ds=train_ds, test_ds=test_ds,
-                       engine=engine, global_batch=global_batch)
+                       engine=engine, global_batch=global_batch,
+                       name=config.engine)
 
 
 def make_lr_schedule(config: ExperimentConfig, total_steps: int):
@@ -517,7 +525,8 @@ def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
                                   _global_batch(config, dp)),
         grad_accum=config.grad_accum)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
-                       engine=engine, global_batch=_global_batch(config, dp))
+                       engine=engine, global_batch=_global_batch(config, dp),
+                       name=f"seq_parallel[{config.attention_impl}]")
 
 
 def _tp_model(config: ExperimentConfig, train_ds, mode: str):
@@ -559,7 +568,8 @@ def _setup_tensor_parallel(config: ExperimentConfig) -> _Experiment:
                                   _global_batch(config, dp)),
         grad_accum=config.grad_accum)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
-                       engine=engine, global_batch=_global_batch(config, dp))
+                       engine=engine, global_batch=_global_batch(config, dp),
+                       name="tensor_parallel")
 
 
 def _setup_fsdp_tp(config: ExperimentConfig) -> _Experiment:
@@ -583,7 +593,8 @@ def _setup_fsdp_tp(config: ExperimentConfig) -> _Experiment:
                                   _global_batch(config, dp)),
         grad_accum=config.grad_accum)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
-                       engine=engine, global_batch=_global_batch(config, dp))
+                       engine=engine, global_batch=_global_batch(config, dp),
+                       name="fsdp_tp[fsdp*tp]")
 
 
 def _require_token_data(train_ds, config: ExperimentConfig, mode: str) -> None:
@@ -735,7 +746,8 @@ def _setup_composite(config: ExperimentConfig) -> _Experiment:
         router_z_weight=config.router_z_weight,
         grad_accum=config.grad_accum)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
-                       engine=engine, global_batch=_global_batch(config, dp))
+                       engine=engine, global_batch=_global_batch(config, dp),
+                       name=f"composite[dp*tp*sp,{config.attention_impl}]")
 
 
 def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
@@ -778,7 +790,8 @@ def _setup_pipeline_parallel(config: ExperimentConfig) -> _Experiment:
                             schedule=config.pipeline_schedule,
                             remat=config.remat)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
-                       engine=engine, global_batch=_global_batch(config, dp))
+                       engine=engine, global_batch=_global_batch(config, dp),
+                       name="pipeline_parallel")
 
 
 def _setup_pipeline_tp(config: ExperimentConfig) -> _Experiment:
@@ -815,7 +828,8 @@ def _setup_pipeline_tp(config: ExperimentConfig) -> _Experiment:
                             schedule=config.pipeline_schedule,
                             remat=config.remat)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
-                       engine=engine, global_batch=_global_batch(config, dp))
+                       engine=engine, global_batch=_global_batch(config, dp),
+                       name=f"pipeline_tp[dp*pp*tp,{config.pipeline_schedule}]")
 
 
 def _setup_expert_parallel(config: ExperimentConfig,
@@ -868,7 +882,8 @@ def _setup_expert_parallel(config: ExperimentConfig,
         grad_accum=config.grad_accum)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine,
-                       global_batch=_global_batch(config, n_token_shards))
+                       global_batch=_global_batch(config, n_token_shards),
+                       name=("expert_tp[dp*ep*tp]" if tp > 1 else "expert_parallel"))
 
 
 def _setup_pipeline_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
@@ -917,7 +932,9 @@ def _setup_pipeline_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
                             schedule=config.pipeline_schedule,
                             remat=config.remat)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
-                       engine=engine, global_batch=_global_batch(config, dp))
+                       engine=engine, global_batch=_global_batch(config, dp),
+                       name=(f"pipeline_tp_sp[dp*pp*tp*sp,{config.attention_impl}]" if tp > 1
+                             else f"pipeline_sp[dp*pp*sp,{config.attention_impl}]"))
 
 
 def _setup_pipeline_tp_sp(config: ExperimentConfig) -> _Experiment:
@@ -977,7 +994,9 @@ def _setup_expert_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
         router_z_weight=config.router_z_weight,
         grad_accum=config.grad_accum)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
-                       engine=engine, global_batch=_global_batch(config, dp))
+                       engine=engine, global_batch=_global_batch(config, dp),
+                       name=(f"expert_tp_sp[dp*ep*tp*sp,{config.attention_impl}]" if tp > 1
+                             else f"expert_sp[dp*ep*sp,{config.attention_impl}]"))
 
 
 def _setup_expert_tp_sp(config: ExperimentConfig) -> _Experiment:
@@ -1080,30 +1099,10 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         ev = trainer.evaluate(test_ds, batch_size=config.eval_batch)
         sink.results(ev["accuracy"], loss=ev["loss"])
 
-        if (config.pipeline_parallel > 1 and config.tensor_parallel > 1
-                and config.seq_parallel > 1):
-            engine_name = (f"pipeline_tp_sp[dp*pp*tp*sp,"
-                           f"{config.attention_impl}]")
-        elif config.seq_parallel > 1 and config.tensor_parallel > 1:
-            engine_name = f"composite[dp*tp*sp,{config.attention_impl}]"
-        elif config.tensor_parallel > 1 and config.engine == "fsdp":
-            engine_name = "fsdp_tp[fsdp*tp]"
-        elif config.pipeline_parallel > 1 and config.tensor_parallel > 1:
-            engine_name = f"pipeline_tp[dp*pp*tp,{config.pipeline_schedule}]"
-        elif config.expert_parallel > 1 and config.tensor_parallel > 1:
-            engine_name = "expert_tp[dp*ep*tp]"
-        elif config.pipeline_parallel > 1 and config.seq_parallel > 1:
-            engine_name = f"pipeline_sp[dp*pp*sp,{config.attention_impl}]"
-        elif config.seq_parallel > 1:
-            engine_name = f"seq_parallel[{config.attention_impl}]"
-        elif config.tensor_parallel > 1:
-            engine_name = "tensor_parallel"
-        elif config.pipeline_parallel > 1:
-            engine_name = "pipeline_parallel"
-        elif config.expert_parallel > 1:
-            engine_name = "expert_parallel"
-        else:
-            engine_name = config.engine
+        # the summary's engine label comes from the _setup_* function that
+        # chose the engine (_Experiment.name) — re-deriving it here from
+        # the config flags drifted from the dispatch table twice
+        engine_name = ex.name
         total_devices = (n * config.seq_parallel * config.tensor_parallel
                          * config.pipeline_parallel * config.expert_parallel)
         model_name = config.model if config.model_fn is None else getattr(

@@ -240,6 +240,7 @@ def test_ep_sp_harness_cli():
         epochs=1, log_every=0,
         model_args={"hidden": 32, "layers": 1, "heads": 2, "ffn": 64}))
     assert out["expert_parallel"] == 2 and out["seq_parallel"] == 2
+    assert out["engine"] == "expert_sp[dp*ep*sp,ring]"
     assert out["steps"] > 0 and out["test_perplexity"] > 0
 
 
@@ -362,3 +363,21 @@ def test_bert_moe_harness_cli():
         model_args={"hidden": 32, "layers": 1, "heads": 2, "ffn": 64}))
     assert out["expert_parallel"] == 2 and out["seq_parallel"] == 2
     assert out["steps"] > 0 and np.isfinite(out["test_loss"])
+
+
+def test_ep_tp_sp_harness_cli():
+    """4-D dp×ep×tp×sp through the harness — and the summary label comes
+    from the setup that chose the engine (the re-derived label ladder
+    mislabeled combos twice before _Experiment.name)."""
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    out = run(ExperimentConfig(
+        model="gpt", dataset="lm_synth", engine="sync", n_devices=8,
+        expert_parallel=2, tensor_parallel=2, seq_parallel=2,
+        num_experts=4, batch_size=4, epochs=1, log_every=0,
+        model_args={"hidden": 32, "layers": 1, "heads": 2, "ffn": 64}))
+    assert out["engine"] == "expert_tp_sp[dp*ep*tp*sp,ring]"
+    assert out["expert_parallel"] == 2 and out["tensor_parallel"] == 2
+    assert out["seq_parallel"] == 2
+    assert out["steps"] > 0 and out["test_perplexity"] > 0

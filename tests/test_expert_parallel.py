@@ -403,3 +403,18 @@ def test_moe_grouped_routing_capacity_is_per_group():
     # 8 assignments, 2 groups × 2 slots kept → overflow = 1 - 4/8 = 0.5
     overflow = float(col["intermediates"]["overflow"][0])
     assert overflow == pytest.approx(0.5)
+
+
+def test_moe_group_size_selection():
+    """Group picking is static and floor-guarded: ≤target → one group;
+    power-of-two divisor in [256, 1024] when available; tiny divisors
+    (tokens with small 2-adic valuation) fall back to one group rather
+    than tiny token-dropping groups."""
+    from distributed_tensorflow_tpu.models.moe import _moe_group_size
+
+    assert _moe_group_size(1024) is None      # fits one group
+    assert _moe_group_size(8192) == 1024
+    assert _moe_group_size(4096) == 1024
+    assert _moe_group_size(1536) == 512       # 1536 = 3·512
+    assert _moe_group_size(2000) is None      # best divisor 16 < floor
+    assert _moe_group_size(1025) is None      # odd
