@@ -163,6 +163,12 @@ class PipelineEngine(Engine):
       GPipe (same grads, different order — tests/test_pipeline.py holds
       both to the same sequential oracle).
 
+    Optional extra mesh axes compose: 'model' (pp×tp, Megatron GSPMD auto
+    axis), 'seq' (pp×sp, manual ring attention inside stages), 'expert'
+    (pp×ep, MoE-FFN stage blocks with experts sharded over a GSPMD auto
+    axis; GPipe only — the router aux/z losses join the objective through
+    the tick scan, gated to real-microbatch ticks).
+
     ``stages`` plugs in custom (embed, block, head) modules — e.g.
     ``models.bert.bert_pipeline_stages`` to pipeline a transformer encoder.
     Contract: ``block(carry) -> carry`` where ``carry`` is whatever pytree
@@ -189,16 +195,21 @@ class PipelineEngine(Engine):
         stages: tuple[nn.Module, nn.Module, nn.Module] | None = None,
         schedule: str = "gpipe",
         remat: bool = False,
+        aux_weight: float = 0.01,
+        router_z_weight: float = 0.0,
+        overflow_warn_threshold: float = 0.25,
+        overflow_window: int = 50,
     ):
         if mesh is None or not {meshlib.DATA_AXIS,
                                 meshlib.PIPE_AXIS} <= set(mesh.axis_names):
             raise ValueError("PipelineEngine requires a ('data','pipe') mesh")
         extra = set(mesh.axis_names) - {meshlib.DATA_AXIS, meshlib.PIPE_AXIS,
-                                        meshlib.MODEL_AXIS, meshlib.SEQ_AXIS}
+                                        meshlib.MODEL_AXIS, meshlib.SEQ_AXIS,
+                                        meshlib.EXPERT_AXIS}
         if extra:
             raise ValueError(
                 f"unsupported mesh axes {sorted(extra)}; PipelineEngine "
-                f"composes data×pipe(×model)(×seq)")
+                f"composes data×pipe(×model)(×seq)(×expert)")
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown schedule '{schedule}'; "
                              f"choose 'gpipe' or '1f1b'")
@@ -242,6 +253,52 @@ class PipelineEngine(Engine):
             self.block = PipelineBlock(hidden=hidden, expansion=expansion,
                                        dtype=dtype)
             self.head = PipelineHead(num_classes=num_classes, dtype=dtype)
+        # pp×ep: MoE-FFN stage blocks (models/gpt.py GPTPipeBlock /
+        # models/bert.py BertPipeBlock with moe_experts > 0) over an
+        # 'expert' GSPMD auto axis — same partial-manual recipe as pp×tp's
+        # 'model' axis, with the router aux losses joining the objective in
+        # the gpipe tick (see _build_step_gpipe).
+        from distributed_tensorflow_tpu.engines.expert_parallel import (
+            _OverflowMonitor)
+
+        self.moe = getattr(self.block, "moe_experts", 0) > 0
+        self.ep_n = mesh.shape.get(meshlib.EXPERT_AXIS, 1)
+        if self.moe and schedule == "1f1b":
+            # 1F1B's backward is hand-scheduled per-stage jax.vjp of the
+            # task cotangent alone — the router aux/z losses would need
+            # their own per-stage cotangent seeds injected into each bwd
+            # sub-tick, which the schedule does not wire.  GPipe
+            # differentiates the whole tick scan, so aux terms flow for
+            # free; it is the schedule that composes with MoE.
+            raise ValueError(
+                "schedule='1f1b' does not compose with MoE stage blocks "
+                "(the hand-scheduled backward carries only the task-loss "
+                "cotangent; router aux losses would silently drop out of "
+                "the objective); use schedule='gpipe' for pp×ep")
+        if self.ep_n > 1:
+            if not self.moe:
+                raise ValueError(
+                    "mesh has an 'expert' axis but the stage block has no "
+                    "MoE FFN (moe_experts == 0); experts would silently "
+                    "replicate")
+            if not getattr(self.block, "partition_experts", False):
+                raise ValueError(
+                    "an 'expert' mesh axis needs partition_experts=True on "
+                    "the stage block — without the "
+                    "with_partitioning('expert') annotations the expert "
+                    "weights replicate and no expert parallelism happens")
+            if getattr(self.block, "moe_experts", 0) % self.ep_n:
+                raise ValueError(
+                    f"moe_experts {self.block.moe_experts} not divisible "
+                    f"by expert axis size {self.ep_n}")
+        self.aux_weight = aux_weight
+        self.router_z_weight = router_z_weight
+        # None on dense pipelines so the harness summary only carries the
+        # router-health fields when there are routers (harness.py reads the
+        # attribute with a None guard)
+        self.overflow_monitor = (_OverflowMonitor(overflow_warn_threshold,
+                                                  overflow_window)
+                                 if self.moe else None)
         self.n_stages = mesh.shape[meshlib.PIPE_AXIS]
         self.microbatches = microbatches
         super().__init__(model=None, optimizer=optimizer, mesh=mesh,
@@ -335,19 +392,40 @@ class PipelineEngine(Engine):
         return self.head.apply({"params": params["head"]}, h)
 
     # ---------------------------------------------------------------- step
+    def step(self, state, x, y):
+        state, metrics = super().step(state, x, y)
+        if self.moe:
+            self.overflow_monitor.observe(metrics["overflow"])
+        return state, metrics
+
     def _build_step(self):
         if self.schedule == "1f1b":
             return self._build_step_1f1b()
         return self._build_step_gpipe()
 
     def _build_step_gpipe(self):
+        from distributed_tensorflow_tpu.engines.expert_parallel import (
+            router_losses)
+
         tx = self.tx
         embed, block, head = self.embed, self.block, self.head
         M = self.microbatches
         sp = self.sp_n
+        moe = self.moe
+        aux_w, z_w = self.aux_weight, self.router_z_weight
 
-        def block_apply(bp, h):
-            return block.apply({"params": bp}, h)
+        if moe:
+            # MoE stage: capture the sown router diagnostics alongside the
+            # activations.  Bubble ticks run the block on garbage buffers
+            # like every other tick; their (finite, meaningless) router
+            # stats are masked out of the objective in the tick below.
+            def block_apply(bp, h):
+                out, col = block.apply({"params": bp}, h,
+                                       mutable=["intermediates"])
+                return out, router_losses(col["intermediates"])
+        else:
+            def block_apply(bp, h):
+                return block.apply({"params": bp}, h)
 
         if self.remat:
             # recompute-in-backward: safe under a manual 'seq' axis because
@@ -406,7 +484,21 @@ class PipelineEngine(Engine):
 
                     h_in = lax.cond((stage == 0) & (i < M), inject,
                                     lambda _: buf, None)
-                    h_out = block_apply(blocks_local, h_in)
+                    if moe:
+                        h_out, (aux_r, z_r, ovf_r) = block_apply(
+                            blocks_local, h_in)
+                        # this device's buffer holds a REAL microbatch
+                        # (number i − stage) only while 0 ≤ i − stage < M;
+                        # bubble ticks' router stats are masked to zero so
+                        # they contribute nothing to the objective (and a
+                        # zero gradient through the multiply)
+                        bvalid = ((i - stage >= 0)
+                                  & (i - stage < M)).astype(jnp.float32)
+                        aux_i = aux_r * bvalid
+                        z_i = z_r * bvalid
+                        ovf_i = ovf_r * bvalid
+                    else:
+                        h_out = block_apply(blocks_local, h_in)
                     # last stage drains microbatch i-(S-1); the head matmul
                     # and loss run only there (again lax.cond, not masking)
                     oi = i - (S - 1)
@@ -441,7 +533,10 @@ class PipelineEngine(Engine):
                     buf_next = jax.tree.map(
                         lambda a: lax.ppermute(a, axis_name=pipe_axis,
                                                perm=perm), h_out)
-                    return buf_next, (loss_i, acc_i, w)
+                    outs = (loss_i, acc_i, w)
+                    if moe:
+                        outs = outs + (aux_i, z_i, ovf_i)
+                    return buf_next, outs
 
                 # buffer shape/dtype comes from the embed output itself, so
                 # any activation pytree (arrays, (h, mask) tuples, ...) works
@@ -453,17 +548,30 @@ class PipelineEngine(Engine):
                                         (data_axis, pipe_axis) + seq_axes,
                                         to="varying"),
                     h0)
-                _, (losses, accs, ws) = lax.scan(
-                    tick, buf0, jnp.arange(M + S - 1))
+                _, ys = lax.scan(tick, buf0, jnp.arange(M + S - 1))
+                if moe:
+                    losses, accs, ws, auxs, zs, ovfs = ys
+                else:
+                    losses, accs, ws = ys
+                    auxs = zs = ovfs = jnp.zeros_like(losses)
                 # nonzero only on the last stage; scale so the implicit psum
                 # over BOTH axes at the AD boundary yields the global batch
-                # mean (same mechanism as engines/sync.py)
-                local_sum = losses.sum()
+                # mean (same mechanism as engines/sync.py).  The router
+                # aux/z sums ride the SAME scale: the pipe psum turns each
+                # stage's local router sum into the sum over ALL the
+                # model's routers (router_losses is a sum over a stage's
+                # routers — matching the composite's sum-over-blocks
+                # objective, engines/composite.py), while /(M·n_data·sp)
+                # averages over the microbatch × data-shard × seq-block
+                # applications.
+                local_sum = losses.sum() + aux_w * auxs.sum() + z_w * zs.sum()
                 scaled = local_sum / (M * n_data * sp)
-                return scaled, (losses.sum(), accs.sum(), ws.sum())
+                return scaled, (losses.sum(), accs.sum(), ws.sum(),
+                                ovfs.sum())
 
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-            (_, (loss_sum, acc_sum, w_sum)), grads = grad_fn(state.params)
+            ((_, (loss_sum, acc_sum, w_sum, ovf_sum)),
+             grads) = grad_fn(state.params)
             updates, opt_state = tx.update(grads, state.opt_state,
                                            state.params)
             params = optax.apply_updates(state.params, updates)
@@ -477,6 +585,12 @@ class PipelineEngine(Engine):
                 "loss": lax.psum(loss_sum, both) / tot_w,
                 "accuracy": lax.psum(acc_sum, both) / tot_w,
             }
+            if moe:
+                # mean over every (stage, microbatch, data-shard, seq-block)
+                # router application — the same overflow_mean semantics as
+                # engines/expert_parallel.py, watched by the monitor in step()
+                metrics["overflow"] = lax.psum(ovf_sum, both) / (
+                    S * M * n_data * sp)
             new_state = state.replace(step=state.step + 1, params=params,
                                       opt_state=opt_state)
             return new_state, metrics
@@ -688,8 +802,10 @@ class PipelineEngine(Engine):
         def step_fn(state, x, y):
             if "fn" not in compiled:
                 spec = _pipe_spec_tree(state)
+                # any mesh axis outside the manual set ('model' for pp×tp,
+                # 'expert' for pp×ep) stays a GSPMD auto axis
                 kw = ({"axis_names": manual}
-                      if meshlib.MODEL_AXIS in self.mesh.axis_names else {})
+                      if set(self.mesh.axis_names) - manual else {})
                 if self.sp_n > 1:
                     x_spec = P(meshlib.DATA_AXIS, meshlib.SEQ_AXIS)
                     y_spec = (P(meshlib.DATA_AXIS, meshlib.SEQ_AXIS)

@@ -317,6 +317,12 @@ class BertPipeBlock(nn.Module):
     layers_per_stage: int = 1
     partition_model: bool = False
     dtype: jnp.dtype = jnp.float32
+    moe_experts: int = 0         # >0: pp×ep — routed MoE FFN per layer
+                                 # (models/moe.py; engines/pipeline.py reads
+                                 # this field for the aux-loss plumbing)
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    partition_experts: bool = False
 
     @nn.compact
     def __call__(self, carry):
@@ -325,7 +331,12 @@ class BertPipeBlock(nn.Module):
             x = TransformerLayer(self.hidden, self.heads, self.ffn,
                                  dropout_rate=0.0, attention_impl="dense",
                                  partition_model=self.partition_model,
-                                 dtype=self.dtype)(x, pad_mask)
+                                 dtype=self.dtype,
+                                 moe_experts=self.moe_experts,
+                                 moe_top_k=self.moe_top_k,
+                                 moe_capacity_factor=self.moe_capacity_factor,
+                                 partition_experts=self.partition_experts)(
+                                     x, pad_mask)
         return x, pad_mask
 
 
@@ -352,15 +363,25 @@ def bert_pipeline_stages(
     layers_per_stage: int = 1,
     partition_model: bool = False,
     dtype: jnp.dtype = jnp.float32,
+    moe_experts: int = 0,
+    moe_top_k: int = 1,
+    moe_capacity_factor: float = 1.25,
+    partition_experts: bool = False,
 ):
     """(embed, block, head) for ``PipelineEngine(stages=...)``: a BERT
     encoder of depth ``pipe_axis_size × layers_per_stage``.
-    ``partition_model=True`` adds Megatron TP annotations for pp×tp."""
+    ``partition_model=True`` adds Megatron TP annotations for pp×tp;
+    ``moe_experts > 0`` + ``partition_experts=True`` makes each layer's FFN
+    a routed MoE sharded over an 'expert' mesh axis (pp×ep,
+    engines/pipeline.py)."""
     return (
         BertPipeEmbed(vocab_size=vocab_size, hidden=hidden, max_len=max_len,
                       partition_model=partition_model, dtype=dtype),
         BertPipeBlock(hidden=hidden, heads=heads, ffn=ffn,
                       layers_per_stage=layers_per_stage,
-                      partition_model=partition_model, dtype=dtype),
+                      partition_model=partition_model, dtype=dtype,
+                      moe_experts=moe_experts, moe_top_k=moe_top_k,
+                      moe_capacity_factor=moe_capacity_factor,
+                      partition_experts=partition_experts),
         BertPipeHead(num_classes=num_classes, hidden=hidden, dtype=dtype),
     )

@@ -632,6 +632,13 @@ class GPTPipeBlock(nn.Module):
     attention_impl: str = "dense"
     seq_axis: str | None = None
     dtype: jnp.dtype = jnp.float32
+    moe_experts: int = 0         # >0: pp×ep — each stage block's FFN is a
+                                 # routed MoE (models/moe.py); the engine
+                                 # reads this field to wire the router
+                                 # aux-loss plumbing (engines/pipeline.py)
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    partition_experts: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -653,7 +660,11 @@ class GPTPipeBlock(nn.Module):
                          seq_axis=self.seq_axis or "seq",
                          partition_model=self.partition_model,
                          rope=self.rope, kv_heads=self.kv_heads,
-                         dtype=self.dtype)(x, pos=pos)
+                         dtype=self.dtype,
+                         moe_experts=self.moe_experts,
+                         moe_top_k=self.moe_top_k,
+                         moe_capacity_factor=self.moe_capacity_factor,
+                         partition_experts=self.partition_experts)(x, pos=pos)
         return x
 
 
@@ -690,6 +701,10 @@ def gpt_pipeline_stages(
     seq_axis: str | None = None,
     dtype: jnp.dtype = jnp.float32,
     num_classes: int | None = None,  # alias for vocab_size (harness passes it)
+    moe_experts: int = 0,
+    moe_top_k: int = 1,
+    moe_capacity_factor: float = 1.25,
+    partition_experts: bool = False,
 ):
     """(embed, block, head) for ``PipelineEngine(stages=...)``: a GPT decoder
     of depth ``pipe_axis_size × layers_per_stage``.  ``partition_model=True``
@@ -697,7 +712,9 @@ def gpt_pipeline_stages(
     position table and rotates q/k inside each block;
     ``attention_impl='ring'`` (etc.) + ``seq_axis='seq'`` makes the stages
     sequence-parallel for pp×sp (the carry rides the pipe ring as a
-    seq-sharded token block)."""
+    seq-sharded token block).  ``moe_experts > 0`` +
+    ``partition_experts=True`` swaps each block's FFN for a routed MoE
+    sharded over an 'expert' mesh axis (pp×ep, engines/pipeline.py)."""
     if num_classes is not None:
         vocab_size = num_classes
     if positional not in ("learned", "rope"):
@@ -712,7 +729,10 @@ def gpt_pipeline_stages(
                      layers_per_stage=layers_per_stage,
                      partition_model=partition_model, rope=rope,
                      kv_heads=kv_heads, attention_impl=attention_impl,
-                     seq_axis=seq_axis, dtype=dtype),
+                     seq_axis=seq_axis, dtype=dtype,
+                     moe_experts=moe_experts, moe_top_k=moe_top_k,
+                     moe_capacity_factor=moe_capacity_factor,
+                     partition_experts=partition_experts),
         GPTPipeHead(vocab_size=vocab_size, hidden=hidden,
                     partition_model=partition_model, dtype=dtype),
     )

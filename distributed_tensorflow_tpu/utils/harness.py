@@ -188,18 +188,19 @@ def _setup(config: ExperimentConfig) -> _Experiment:
             frozenset({"expert_parallel", "seq_parallel"}): _setup_expert_sp,
             frozenset({"expert_parallel", "tensor_parallel",
                        "seq_parallel"}): _setup_expert_tp_sp,
+            frozenset({"pipeline_parallel", "expert_parallel"}):
+                _setup_pipeline_ep,
         }
         setup = combos.get(frozenset(multi))
         if setup is None:
-            # the two remaining holes are rejected WITH their reasons, not
-            # silently missing from the list (VERDICT r4 #5):
-            # * pipeline × fsdp/expert — the pipeline schedules run manual
-            #   over 'pipe' with each device holding ONLY its stage's
-            #   params; ZeRO's gather-per-use (fsdp) and the GShard
-            #   dispatch all-to-alls (ep) are GSPMD patterns that would
-            #   have to cross the manual pipe axis mid-schedule, which
-            #   shard_map forbids (a collective cannot span a manual axis
-            #   it is not mapped over)
+            # the remaining hole is rejected WITH its reason, not silently
+            # missing from the list (VERDICT r4 #5):
+            # * pipeline × fsdp — ZeRO shards params/optimizer over 'data',
+            #   which is a MANUAL axis in the pipeline shard_map (the
+            #   schedule's ppermute ring needs it manual), so the
+            #   gather-per-use all-gathers cannot be GSPMD-inserted there;
+            #   'expert' and 'model' compose because they stay GSPMD auto
+            #   axes (pp×tp, pp×ep)
             raise ValueError(
                 f"{' and '.join(multi)} cannot be combined; composable in "
                 f"this release: tensor_parallel × seq_parallel (dp×tp×sp), "
@@ -207,14 +208,14 @@ def _setup(config: ExperimentConfig) -> _Experiment:
                 f"expert_parallel × tensor_parallel (dp×ep×tp), "
                 f"expert_parallel × seq_parallel (dp×ep×sp), "
                 f"pipeline_parallel × seq_parallel (dp×pp×sp), "
+                f"pipeline_parallel × expert_parallel (dp×pp×ep), "
                 f"pipeline_parallel × tensor_parallel × seq_parallel "
                 f"(dp×pp×tp×sp) and expert_parallel × tensor_parallel × "
                 f"seq_parallel (dp×ep×tp×sp, 4-D meshes).  Not composable, "
-                f"by design: pipeline × expert and pipeline × fsdp — the "
-                f"pipeline schedules are manual over 'pipe' with per-stage "
-                f"param ownership, so ZeRO's gather-per-use and the GShard "
-                f"dispatch all-to-alls (both GSPMD) would have to cross a "
-                f"manual axis mid-schedule, which shard_map forbids")
+                f"by design: pipeline × fsdp — ZeRO shards state over "
+                f"'data', a manual axis in the pipeline shard_map, so the "
+                f"gather-per-use all-gathers cannot be GSPMD-inserted "
+                f"mid-schedule")
         return setup(config)
     if config.seq_parallel > 1:
         return _setup_seq_parallel(config)
@@ -683,15 +684,22 @@ def _stage_model_args(config: ExperimentConfig, mode: str) -> dict:
 def _pipeline_stages(config: ExperimentConfig, train_ds, test_ds, mode: str,
                      partition_model: bool = False,
                      attention_impl: str = "dense",
-                     seq_axis: str | None = None):
+                     seq_axis: str | None = None,
+                     moe: bool = False):
     """(embed, block, head) for the pipeline setups, by model family:
     BERT encoder (models/bert.py) or GPT decoder LM (models/gpt.py).
     ``attention_impl``/``seq_axis`` make the GPT stages sequence-parallel
-    for dp×pp×sp.  ``--model-arg heads/ffn/layers_per_stage`` size the
-    stages (_stage_model_args)."""
+    for dp×pp×sp.  ``moe=True`` (pp×ep) makes each stage block's FFN a
+    routed MoE sized by ``--num-experts``/``--router-top-k``, with
+    'expert'-axis partitioning annotations.  ``--model-arg
+    heads/ffn/layers_per_stage`` size the stages (_stage_model_args)."""
     _require_token_data(train_ds, config, mode)
     dtype = modellib.resolve_dtype(config.dtype)
     extra = _stage_model_args(config, mode)
+    if moe:
+        extra.update(moe_experts=config.num_experts,
+                     moe_top_k=config.router_top_k,
+                     partition_experts=True)
     if config.model in _LM_MODELS:
         from distributed_tensorflow_tpu.models.gpt import gpt_pipeline_stages
 
@@ -830,6 +838,53 @@ def _setup_pipeline_tp(config: ExperimentConfig) -> _Experiment:
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name=f"pipeline_tp[dp*pp*tp,{config.pipeline_schedule}]")
+
+
+def _setup_pipeline_ep(config: ExperimentConfig) -> _Experiment:
+    """dp×pp×ep: 3-D (data, pipe, expert) mesh — GPipe schedule manual over
+    (data, pipe), each stage block's FFN a routed MoE whose experts shard
+    over 'expert' as a GSPMD auto axis (engines/pipeline.py; same
+    partial-manual recipe as pp×tp's 'model' axis).  The batch shards over
+    'data' only — the expert axis holds experts, not tokens, exactly as the
+    'model' axis holds Megatron shards in pp×tp.  GPipe only: 1F1B's
+    hand-scheduled backward carries no router aux cotangent (the engine
+    rejects it with that reason)."""
+    from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
+
+    mesh, dp = _split_mesh(config, config.pipeline_parallel,
+                           "pipeline_parallel×expert_parallel",
+                           meshlib.PIPE_AXIS,
+                           (config.expert_parallel, meshlib.EXPERT_AXIS))
+    train_ds, test_ds = _load_data(config)
+    if config.model not in _SEQUENCE_MODELS or config.model_fn is not None:
+        raise ValueError(
+            f"pipeline×expert parallelism ships MoE-FFN stages for "
+            f"{'/'.join(_SEQUENCE_MODELS)} (got --model {config.model}); "
+            f"custom models pass stages whose block carries moe_experts/"
+            f"partition_experts (models/moe.py MoELayer) to PipelineEngine")
+    if config.num_experts % config.expert_parallel:
+        raise ValueError(
+            f"num_experts {config.num_experts} not divisible by "
+            f"expert_parallel {config.expert_parallel}")
+    stages = _pipeline_stages(config, train_ds, test_ds,
+                              "pipeline_parallel×expert_parallel", moe=True)
+    if (_global_batch(config, dp) // dp) % config.microbatches:
+        raise ValueError(
+            f"per-data-shard batch {_global_batch(config, dp) // dp} not "
+            f"divisible by microbatches {config.microbatches}")
+    engine = PipelineEngine(microbatches=config.microbatches, mesh=mesh,
+                            learning_rate=config.learning_rate,
+                            optimizer=_make_optimizer(
+                                config, train_ds,
+                                _global_batch(config, dp)),
+                            stages=stages,
+                            schedule=config.pipeline_schedule,
+                            remat=config.remat,
+                            aux_weight=config.aux_weight,
+                            router_z_weight=config.router_z_weight)
+    return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
+                       engine=engine, global_batch=_global_batch(config, dp),
+                       name=f"pipeline_ep[dp*pp*ep,{config.pipeline_schedule}]")
 
 
 def _setup_expert_parallel(config: ExperimentConfig,

@@ -557,3 +557,179 @@ def test_gpipe_remat_composes_with_seq_parallel():
     jax.tree.map(
         lambda a, e: np.testing.assert_allclose(a, e, atol=2e-5, rtol=1e-4),
         after, expected)
+
+
+# ------------------------------------------------------------- pp × ep (MoE)
+
+
+def _pp_ep_mesh(dp=2, pp=2, ep=2):
+    return meshlib.create_mesh(
+        dp * pp * ep, shape=(dp, pp, ep),
+        axis_names=(meshlib.DATA_AXIS, meshlib.PIPE_AXIS,
+                    meshlib.EXPERT_AXIS))
+
+
+def _chunked_moe_oracle(eng, x, y, dp):
+    """Per-(data-shard, microbatch) sequential oracle for MoE pipelines.
+
+    Routing is capacity-limited per CALL (models/moe.py: capacity and
+    grouping derive from the tokens the layer sees), so the oracle must
+    apply the stages to exactly the chunks the schedule feeds them — a
+    full-batch forward would route with a different capacity and is NOT
+    the same function.  Returns total_objective_fn, task_loss_fn closing
+    over the chunk decomposition."""
+    from distributed_tensorflow_tpu.engines.expert_parallel import (
+        router_losses)
+
+    M, S = eng.microbatches, eng.n_stages
+    per, mb = x.shape[0] // dp, x.shape[0] // dp // M
+    aux_w, z_w = eng.aux_weight, eng.router_z_weight
+
+    def chunk_losses(params, xc, yc):
+        h = eng.embed.apply({"params": params["embed"]}, xc)
+        aux = z = 0.0
+        for s in range(S):
+            bp = jax.tree.map(lambda a: a[s], params["blocks"])
+            h, col = eng.block.apply({"params": bp}, h,
+                                     mutable=["intermediates"])
+            a_s, z_s, _ = router_losses(col["intermediates"])
+            aux, z = aux + a_s, z + z_s
+        logits = eng.head.apply({"params": params["head"]}, h)
+        return cross_entropy(logits, jnp.asarray(yc)).mean(), aux, z
+
+    def ref_total(params):
+        total = 0.0
+        for d in range(dp):
+            for m_i in range(M):
+                sl = slice(d * per + m_i * mb, d * per + (m_i + 1) * mb)
+                ce, aux, z = chunk_losses(params, x[sl], y[sl])
+                total = total + ce + aux_w * aux + z_w * z
+        return total / (dp * M)
+
+    def ref_task(params):
+        return sum(
+            chunk_losses(params, x[d * per + m_i * mb:
+                                   d * per + (m_i + 1) * mb],
+                         y[d * per + m_i * mb: d * per + (m_i + 1) * mb])[0]
+            for d in range(dp) for m_i in range(M)) / (dp * M)
+
+    return ref_total, ref_task
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("remat", [False, True])
+def test_pipeline_moe_matches_chunked_oracle(remat):
+    """dp×pp×ep GPT decoder with MoE-FFN stages: the pipelined step must
+    equal the per-chunk sequential oracle — task loss AND one SGD step of
+    the full objective (task + aux_weight·aux + z·z_loss summed over every
+    stage's routers, averaged over microbatch×shard applications).  Expert
+    weights must actually shard ('pipe', 'expert', ...).  remat=True holds
+    the jax.checkpoint'd MoE block_apply to the same oracle: the router
+    diagnostics are explicit checkpoint OUTPUTS here (not re-sown state),
+    so recompute-in-backward cannot double-count them — unlike the GSPMD
+    model path, which rejects remat+MoE for exactly that sow reason
+    (models/gpt.py GPTLM)."""
+    from distributed_tensorflow_tpu.models.gpt import gpt_pipeline_stages
+
+    lr, aux_w, z_w = 0.1, 0.01, 1e-3
+    eng = PipelineEngine(
+        microbatches=2, mesh=_pp_ep_mesh(), optimizer=optax.sgd(lr),
+        aux_weight=aux_w, router_z_weight=z_w, remat=remat,
+        stages=gpt_pipeline_stages(vocab_size=64, hidden=32, heads=2,
+                                   ffn=64, max_len=16, moe_experts=4,
+                                   partition_experts=True))
+    x, y = _lm_tokens()
+    state = eng.init_state(jax.random.key(0), x)
+    w1 = state.params["blocks"]["GPTBlock_0"]["MoELayer_0"]["w1"]
+    assert w1.sharding.spec == (meshlib.PIPE_AXIS, meshlib.EXPERT_AXIS,
+                                None, None)
+    before = jax.device_get(state.params)
+    state, m = eng.step(state, *eng.shard_batch(x, y))
+    after = jax.device_get(state.params)
+
+    ref_total, ref_task = _chunked_moe_oracle(eng, x, y, dp=2)
+    assert float(m["loss"]) == pytest.approx(float(ref_task(before)),
+                                             abs=1e-5)
+    assert 0.0 <= float(m["overflow"]) <= 1.0
+    grads = jax.grad(ref_total)(before)
+    expected = jax.tree.map(lambda p, g: p - lr * g, before, grads)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(a, e, atol=2e-5, rtol=1e-4),
+        after, expected)
+
+
+@pytest.mark.slow
+def test_bert_pipeline_moe_matches_chunked_oracle():
+    """Same pp×ep oracle parity for the BERT encoder family (the stage
+    carry is (activations, pad_mask) and the head is the [CLS] pooler)."""
+    from distributed_tensorflow_tpu.models.bert import bert_pipeline_stages
+
+    lr, aux_w = 0.1, 0.01
+    eng = PipelineEngine(
+        microbatches=2, mesh=_pp_ep_mesh(), optimizer=optax.sgd(lr),
+        aux_weight=aux_w,
+        stages=bert_pipeline_stages(num_classes=2, vocab_size=64, hidden=32,
+                                    heads=2, ffn=64, max_len=16,
+                                    moe_experts=4, partition_experts=True))
+    rnd = np.random.default_rng(3)
+    x = rnd.integers(1, 64, (8, 16)).astype(np.int32)
+    y = (np.arange(8) % 2).astype(np.int32)
+    state = eng.init_state(jax.random.key(0), x)
+    before = jax.device_get(state.params)
+    state, m = eng.step(state, *eng.shard_batch(x, y))
+    after = jax.device_get(state.params)
+
+    ref_total, ref_task = _chunked_moe_oracle(eng, x, y, dp=2)
+    assert float(m["loss"]) == pytest.approx(float(ref_task(before)),
+                                             abs=1e-5)
+    grads = jax.grad(ref_total)(before)
+    expected = jax.tree.map(lambda p, g: p - lr * g, before, grads)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(a, e, atol=2e-5, rtol=1e-4),
+        after, expected)
+
+
+def test_pipeline_moe_rejects_1f1b():
+    """1F1B's hand-scheduled backward carries only the task cotangent —
+    router aux losses would silently drop; the engine must say so."""
+    from distributed_tensorflow_tpu.models.gpt import gpt_pipeline_stages
+
+    with pytest.raises(ValueError, match="1f1b.*MoE|MoE.*1f1b|gpipe"):
+        PipelineEngine(
+            microbatches=2, mesh=_pp_ep_mesh(), schedule="1f1b",
+            stages=gpt_pipeline_stages(vocab_size=64, hidden=32, heads=2,
+                                       ffn=64, max_len=16, moe_experts=4,
+                                       partition_experts=True))
+
+
+def test_pipeline_expert_axis_requires_moe_stages():
+    """An 'expert' mesh axis with dense stages would silently replicate —
+    loud rejection instead."""
+    from distributed_tensorflow_tpu.models.gpt import gpt_pipeline_stages
+
+    with pytest.raises(ValueError, match="expert"):
+        PipelineEngine(
+            microbatches=2, mesh=_pp_ep_mesh(),
+            stages=gpt_pipeline_stages(vocab_size=64, hidden=32, heads=2,
+                                       ffn=64, max_len=16))
+
+
+@pytest.mark.slow
+def test_pipeline_ep_harness():
+    """`-pp 2 -ep 2 --model gpt --num-experts 4` end-to-end through the
+    harness, including the overflow metric plumbing."""
+    from distributed_tensorflow_tpu.data.loaders import load_lm_dataset
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    def lm_fn(batch_size, type="train", **kw):
+        return load_lm_dataset(seq_len=16, vocab_size=64, n_train=128,
+                               n_test=64, split=type)
+
+    summary = run(ExperimentConfig(
+        engine="sync", model="gpt", dataset="lm_synth", n_devices=8,
+        pipeline_parallel=2, expert_parallel=2, num_experts=4,
+        microbatches=2, batch_size=4, epochs=1, log_every=0,
+        dataset_fn=lm_fn))
+    assert summary["engine"] == "pipeline_ep[dp*pp*ep,gpipe]"
+    assert np.isfinite(summary["test_loss"])
