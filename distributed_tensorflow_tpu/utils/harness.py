@@ -190,6 +190,10 @@ def _setup(config: ExperimentConfig) -> _Experiment:
                        "seq_parallel"}): _setup_expert_tp_sp,
             frozenset({"pipeline_parallel", "expert_parallel"}):
                 _setup_pipeline_ep,
+            frozenset({"pipeline_parallel", "expert_parallel",
+                       "tensor_parallel"}): _setup_pipeline_ep_tp,
+            frozenset({"pipeline_parallel", "expert_parallel",
+                       "seq_parallel"}): _setup_pipeline_ep_sp,
         }
         setup = combos.get(frozenset(multi))
         if setup is None:
@@ -208,7 +212,8 @@ def _setup(config: ExperimentConfig) -> _Experiment:
                 f"expert_parallel × tensor_parallel (dp×ep×tp), "
                 f"expert_parallel × seq_parallel (dp×ep×sp), "
                 f"pipeline_parallel × seq_parallel (dp×pp×sp), "
-                f"pipeline_parallel × expert_parallel (dp×pp×ep), "
+                f"pipeline_parallel × expert_parallel (dp×pp×ep, also "
+                f"× tensor_parallel or × seq_parallel on 4-D meshes), "
                 f"pipeline_parallel × tensor_parallel × seq_parallel "
                 f"(dp×pp×tp×sp) and expert_parallel × tensor_parallel × "
                 f"seq_parallel (dp×ep×tp×sp, 4-D meshes).  Not composable, "
@@ -840,7 +845,8 @@ def _setup_pipeline_tp(config: ExperimentConfig) -> _Experiment:
                        name=f"pipeline_tp[dp*pp*tp,{config.pipeline_schedule}]")
 
 
-def _setup_pipeline_ep(config: ExperimentConfig) -> _Experiment:
+def _setup_pipeline_ep(config: ExperimentConfig, tp: int = 1,
+                       sp: int = 1) -> _Experiment:
     """dp×pp×ep: 3-D (data, pipe, expert) mesh — GPipe schedule manual over
     (data, pipe), each stage block's FFN a routed MoE whose experts shard
     over 'expert' as a GSPMD auto axis (engines/pipeline.py; same
@@ -848,26 +854,48 @@ def _setup_pipeline_ep(config: ExperimentConfig) -> _Experiment:
     'data' only — the expert axis holds experts, not tokens, exactly as the
     'model' axis holds Megatron shards in pp×tp.  GPipe only: 1F1B's
     hand-scheduled backward carries no router aux cotangent (the engine
-    rejects it with that reason)."""
+    rejects it with that reason).
+
+    ``tp > 1`` adds a 'model' GSPMD axis (dp×pp×ep×tp, 4-D mesh): GShard's
+    2-D expert layout inside pipeline stages — each expert's FFN is
+    additionally Megatron-split, w1 sharded ('pipe','expert',·,'model').
+    ``sp > 1`` adds a manual 'seq' axis (dp×pp×ep×sp): the long-context
+    MoE pipeline — ring attention over seq-sharded carries while each seq
+    device routes its token block to the globally-sharded experts."""
     from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
 
-    mesh, dp = _split_mesh(config, config.pipeline_parallel,
-                           "pipeline_parallel×expert_parallel",
-                           meshlib.PIPE_AXIS,
-                           (config.expert_parallel, meshlib.EXPERT_AXIS))
-    train_ds, test_ds = _load_data(config)
-    if config.model not in _SEQUENCE_MODELS or config.model_fn is not None:
+    mode = "pipeline_parallel×expert_parallel" + (
+        "×tensor_parallel" if tp > 1 else "") + (
+        "×seq_parallel" if sp > 1 else "")
+    lm_only = sp > 1  # a seq-sharded carry cannot serve a [CLS] head
+    family = _LM_MODELS if lm_only else _SEQUENCE_MODELS
+    if config.model not in family or config.model_fn is not None:
         raise ValueError(
-            f"pipeline×expert parallelism ships MoE-FFN stages for "
-            f"{'/'.join(_SEQUENCE_MODELS)} (got --model {config.model}); "
-            f"custom models pass stages whose block carries moe_experts/"
-            f"partition_experts (models/moe.py MoELayer) to PipelineEngine")
+            f"{mode} ships MoE-FFN stages for {'/'.join(family)} "
+            f"(got --model {config.model}); custom models pass stages "
+            f"whose block carries moe_experts/partition_experts "
+            f"(models/moe.py MoELayer) to PipelineEngine")
+    if sp > 1 and config.attention_impl == "flash":
+        raise ValueError(
+            "--attention flash is the single-device kernel; with "
+            "--seq-parallel use ring or ring_flash")
     if config.num_experts % config.expert_parallel:
         raise ValueError(
             f"num_experts {config.num_experts} not divisible by "
             f"expert_parallel {config.expert_parallel}")
-    stages = _pipeline_stages(config, train_ds, test_ds,
-                              "pipeline_parallel×expert_parallel", moe=True)
+    extra = [(config.expert_parallel, meshlib.EXPERT_AXIS)]
+    if tp > 1:
+        extra.append((tp, meshlib.MODEL_AXIS))
+    if sp > 1:
+        extra.append((sp, meshlib.SEQ_AXIS))
+    mesh, dp = _split_mesh(config, config.pipeline_parallel, mode,
+                           meshlib.PIPE_AXIS, *extra)
+    train_ds, test_ds = _load_data(config)
+    stages = _pipeline_stages(
+        config, train_ds, test_ds, mode, moe=True,
+        partition_model=tp > 1,
+        attention_impl=config.attention_impl if sp > 1 else "dense",
+        seq_axis=meshlib.SEQ_AXIS if sp > 1 else None)
     if (_global_batch(config, dp) // dp) % config.microbatches:
         raise ValueError(
             f"per-data-shard batch {_global_batch(config, dp) // dp} not "
@@ -882,9 +910,22 @@ def _setup_pipeline_ep(config: ExperimentConfig) -> _Experiment:
                             remat=config.remat,
                             aux_weight=config.aux_weight,
                             router_z_weight=config.router_z_weight)
+    tag = ("pipeline_ep_tp[dp*pp*ep*tp]" if tp > 1
+           else f"pipeline_ep_sp[dp*pp*ep*sp,{config.attention_impl}]"
+           if sp > 1 else f"pipeline_ep[dp*pp*ep,{config.pipeline_schedule}]")
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
-                       name=f"pipeline_ep[dp*pp*ep,{config.pipeline_schedule}]")
+                       name=tag)
+
+
+def _setup_pipeline_ep_tp(config: ExperimentConfig) -> _Experiment:
+    """dp×pp×ep×tp (4-D mesh) — see _setup_pipeline_ep(tp=...)."""
+    return _setup_pipeline_ep(config, tp=config.tensor_parallel)
+
+
+def _setup_pipeline_ep_sp(config: ExperimentConfig) -> _Experiment:
+    """dp×pp×ep×sp (4-D mesh) — see _setup_pipeline_ep(sp=...)."""
+    return _setup_pipeline_ep(config, sp=config.seq_parallel)
 
 
 def _setup_expert_parallel(config: ExperimentConfig,

@@ -733,3 +733,115 @@ def test_pipeline_ep_harness():
         dataset_fn=lm_fn))
     assert summary["engine"] == "pipeline_ep[dp*pp*ep,gpipe]"
     assert np.isfinite(summary["test_loss"])
+
+
+# ---------------------------------------------- pp × ep × tp / pp × ep × sp
+
+
+def _ep4_mesh(extra_axis):
+    return meshlib.create_mesh(
+        8, shape=(1, 2, 2, 2),
+        axis_names=(meshlib.DATA_AXIS, meshlib.PIPE_AXIS,
+                    meshlib.EXPERT_AXIS, extra_axis))
+
+
+@pytest.mark.slow
+def test_pipeline_ep_tp_matches_sequential():
+    """dp×pp×ep×tp (4-D mesh): GShard's 2-D expert layout inside pipeline
+    stages — expert FFNs sharded over BOTH 'expert' and 'model' as GSPMD
+    auto axes while the pipe schedule stays manual.  Drop-free capacity +
+    aux off makes routing grouping-invariant, so the un-pipelined
+    sequential forward is the exact oracle (same construction as
+    tests/test_composite.py test_ep_sp_matches_single_device)."""
+    from distributed_tensorflow_tpu.models.gpt import gpt_pipeline_stages
+
+    lr = 0.1
+    eng = PipelineEngine(
+        microbatches=2, mesh=_ep4_mesh(meshlib.MODEL_AXIS),
+        optimizer=optax.sgd(lr), aux_weight=0.0,
+        stages=gpt_pipeline_stages(vocab_size=64, hidden=32, heads=2,
+                                   ffn=64, max_len=16, moe_experts=4,
+                                   partition_experts=True,
+                                   partition_model=True,
+                                   moe_capacity_factor=4.0))
+    x, y = _lm_tokens()
+    state = eng.init_state(jax.random.key(0), x)
+    w1 = state.params["blocks"]["GPTBlock_0"]["MoELayer_0"]["w1"]
+    assert w1.sharding.spec == (meshlib.PIPE_AXIS, meshlib.EXPERT_AXIS,
+                                None, meshlib.MODEL_AXIS)
+    before = jax.device_get(state.params)
+    state, m = eng.step(state, *eng.shard_batch(x, y))
+    after = jax.device_get(state.params)
+    assert float(m["overflow"]) == 0.0  # capacity covers everything
+
+    def ref_loss(params):
+        logits = eng._sequential_logits(params, x)
+        return cross_entropy(logits, jnp.asarray(y)).mean()
+
+    assert float(m["loss"]) == pytest.approx(float(ref_loss(before)),
+                                             abs=1e-5)
+    grads = jax.grad(ref_loss)(before)
+    expected = jax.tree.map(lambda p, g: p - lr * g, before, grads)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(a, e, atol=2e-5, rtol=1e-4),
+        after, expected)
+
+
+@pytest.mark.slow
+def test_pipeline_ep_sp_matches_sequential():
+    """dp×pp×ep×sp (4-D mesh): the long-context MoE pipeline — ring
+    attention manual over 'seq' inside each stage while each seq device's
+    token block routes to the 'expert'-sharded experts via GSPMD.  Same
+    drop-free oracle construction as the ep×tp variant."""
+    from distributed_tensorflow_tpu.models.gpt import gpt_pipeline_stages
+
+    lr = 0.1
+    eng = PipelineEngine(
+        microbatches=2, mesh=_ep4_mesh(meshlib.SEQ_AXIS),
+        optimizer=optax.sgd(lr), aux_weight=0.0,
+        stages=gpt_pipeline_stages(vocab_size=64, hidden=32, heads=2,
+                                   ffn=64, max_len=16, moe_experts=4,
+                                   partition_experts=True,
+                                   attention_impl="ring", seq_axis="seq",
+                                   moe_capacity_factor=4.0))
+    x, y = _lm_tokens()
+    state = eng.init_state(jax.random.key(0), x)
+    before = jax.device_get(state.params)
+    state, m = eng.step(state, *eng.shard_batch(x, y))
+    after = jax.device_get(state.params)
+    assert float(m["overflow"]) == 0.0
+
+    def ref_loss(params):
+        logits = eng._sequential_logits(params, x)
+        return cross_entropy(logits, jnp.asarray(y)).mean()
+
+    assert float(m["loss"]) == pytest.approx(float(ref_loss(before)),
+                                             abs=1e-5)
+    grads = jax.grad(ref_loss)(before)
+    expected = jax.tree.map(lambda p, g: p - lr * g, before, grads)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(a, e, atol=2e-5, rtol=1e-4),
+        after, expected)
+
+
+@pytest.mark.slow
+def test_pipeline_ep_composites_harness():
+    """`-pp 2 -ep 2 -tp 2` and `-pp 2 -ep 2 -sp 2` resolve through the
+    harness combo table to the 4-D pipeline engines and train."""
+    from distributed_tensorflow_tpu.data.loaders import load_lm_dataset
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    def lm_fn(batch_size, type="train", **kw):
+        return load_lm_dataset(seq_len=16, vocab_size=64, n_train=64,
+                               n_test=32, split=type)
+
+    for extra, tag in ((dict(tensor_parallel=2), "pipeline_ep_tp"),
+                       (dict(seq_parallel=2), "pipeline_ep_sp")):
+        summary = run(ExperimentConfig(
+            engine="sync", model="gpt", dataset="lm_synth", n_devices=8,
+            pipeline_parallel=2, expert_parallel=2, num_experts=4,
+            microbatches=2, batch_size=8, epochs=1, log_every=0,
+            dataset_fn=lm_fn, **extra))
+        assert summary["engine"].startswith(tag), summary["engine"]
+        assert np.isfinite(summary["test_loss"])
