@@ -19,4 +19,31 @@ Layering (SURVEY.md §7.2):
 
 __version__ = "0.1.0"
 
-from distributed_tensorflow_tpu.parallel import mesh, collectives  # noqa: F401
+
+def _honor_platform_env() -> None:
+    """Re-assert the user's JAX platform env choice over preloaded plugins.
+
+    Environments that preload a PJRT plugin from sitecustomize (e.g. a
+    remote-TPU tunnel) may force ``jax_platforms`` via ``jax.config`` at
+    interpreter start, which silently overrides the ``JAX_PLATFORMS`` /
+    ``JAX_PLATFORM_NAME`` env vars the fake-CPU-mesh recipes use (README).
+    Re-applying the env choice at package import — before any backend is
+    initialized in every supported entry path (CLI, examples, library use:
+    all import this package before touching a jax device API) — means no
+    entry script needs its own boilerplate, and a forgotten preamble can't
+    hang on an unreachable accelerator.  No-op when neither env var is
+    set, so programmatic users who configure platforms via jax.config
+    directly are untouched."""
+    import os
+
+    want = (os.environ.get("JAX_PLATFORM_NAME")
+            or os.environ.get("JAX_PLATFORMS"))
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+
+
+_honor_platform_env()
+
+from distributed_tensorflow_tpu.parallel import mesh, collectives  # noqa: E402,F401

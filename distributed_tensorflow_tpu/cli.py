@@ -249,22 +249,14 @@ def select_engine(args: argparse.Namespace) -> str:
 def _honor_platform_env() -> None:
     """Re-assert the user's JAX platform choice over preloaded plugins.
 
-    Environments that preload a PJRT plugin from sitecustomize (e.g. a
-    remote-TPU tunnel) may force ``jax_platforms`` via ``jax.config`` at
-    interpreter start, which silently overrides the ``JAX_PLATFORMS`` /
-    ``JAX_PLATFORM_NAME`` env vars the fake-CPU-mesh recipe uses (README:
-    testing multi-device flows without chips).  Re-apply the env choice
-    here — valid because no backend has been initialized yet when main()
-    starts.  Without this, a CPU-requested CLI run can hang trying to
-    initialize an unreachable accelerator backend."""
-    import os
+    The package __init__ already runs this at import time (see
+    distributed_tensorflow_tpu._honor_platform_env — the single
+    definition); main() re-asserts for belt-and-braces in embedding
+    scenarios where the host process imported jax (but initialized no
+    backend) before setting the env vars and importing us."""
+    from distributed_tensorflow_tpu import _honor_platform_env as _honor
 
-    want = (os.environ.get("JAX_PLATFORM_NAME")
-            or os.environ.get("JAX_PLATFORMS"))
-    if want:
-        import jax
-
-        jax.config.update("jax_platforms", want)
+    _honor()
 
 
 def main(argv: list[str] | None = None, *, model_fn=None,
