@@ -31,13 +31,22 @@ def _honor_platform_env() -> None:
     initialized in every supported entry path (CLI, examples, library use:
     all import this package before touching a jax device API) — means no
     entry script needs its own boilerplate, and a forgotten preamble can't
-    hang on an unreachable accelerator.  No-op when neither env var is
-    set, so programmatic users who configure platforms via jax.config
-    directly are untouched."""
+    hang on an unreachable accelerator.
+
+    Precedence matches JAX's own: a non-empty ``JAX_PLATFORMS`` wins,
+    the deprecated ``JAX_PLATFORM_NAME`` is the fallback (the README
+    recipe sets ``JAX_PLATFORMS="" JAX_PLATFORM_NAME=cpu``, which lands
+    on cpu through the fallback).  No-op when neither env var is set.
+    Known tradeoff: with an env var SET, this import-time hook re-applies
+    it over any earlier programmatic ``jax.config.update`` — that is the
+    point (the sitecustomize preload IS such an update).  An embedding
+    application that wants a different platform than its env vars say
+    should update ``jax.config`` AFTER importing this package, or unset
+    the env vars."""
     import os
 
-    want = (os.environ.get("JAX_PLATFORM_NAME")
-            or os.environ.get("JAX_PLATFORMS"))
+    want = (os.environ.get("JAX_PLATFORMS")
+            or os.environ.get("JAX_PLATFORM_NAME"))
     if want:
         import jax
 
