@@ -156,8 +156,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sample", type=int, default=0, metavar="N",
                    help="after training a GPT LM, greedy-decode N tokens "
                         "per prompt from the final params (KV-cache "
-                        "sampler; multi-device over the run's mesh) and "
-                        "record prompts+continuations in the summary")
+                        "sampler, multi-device over the run's mesh; under "
+                        "--pipeline-parallel a sequential-forward decode "
+                        "over the pipe-stacked stages) and record "
+                        "prompts+continuations in the summary")
     p.add_argument("--sample-prompt-len", type=int, default=8,
                    help="prompt tokens taken from the test split per "
                         "sampled row (--sample)")

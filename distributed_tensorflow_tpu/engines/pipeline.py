@@ -832,3 +832,53 @@ class PipelineEngine(Engine):
         # GSPMD jit: blocks stay sharded over 'pipe'; XLA moves stage params
         # to where the scan needs them
         return self._build_eval_gspmd(self._sequential_logits)
+
+    # ------------------------------------------------------------- generate
+    def generate(self, state: TrainState, prompt, max_new_tokens: int):
+        """Greedy-decode ``max_new_tokens`` per prompt row from pipe-stacked
+        GPT stage params.
+
+        KV caches don't exist for stacked stages (each GPTBlock's cache
+        would need a 'pipe'-stacked twin threaded through the schedule), so
+        decoding reuses the eval path instead: one fixed-length sequential
+        forward (``_sequential_logits`` — GSPMD moves stage params through
+        the block scan) inside a ``lax.fori_loop`` that fills one token per
+        iteration.  Causal attention makes the not-yet-written zero padding
+        invisible to positions already decoded, so ONE compile covers the
+        whole decode; cost is O(N) full forwards instead of the KV sampler's
+        O(N) single-token steps — the right trade for post-train sampling,
+        wrong for serving (which would re-assemble a monolithic model from
+        a checkpoint instead).
+
+        ``prompt``: (B, P) int32 token ids.  Returns (B, P + N) int32 —
+        prompt followed by the greedy continuation.  GPT stage families
+        only (the BERT stages end in a classifier, not a vocab head)."""
+        from distributed_tensorflow_tpu.models.gpt import GPTPipeEmbed
+
+        if not isinstance(self.embed, GPTPipeEmbed):
+            raise ValueError(
+                f"generate needs GPT decoder stages (vocab-head output); "
+                f"this engine's embed stage is "
+                f"{type(self.embed).__name__}")
+        prompt = jnp.asarray(prompt, jnp.int32)
+        if prompt.ndim != 2:
+            raise ValueError(f"prompt must be (batch, len), got "
+                             f"{prompt.shape}")
+        p_len = prompt.shape[1]
+        total = p_len + int(max_new_tokens)
+        if total > self.embed.max_len:
+            raise ValueError(
+                f"prompt {p_len} + {max_new_tokens} new tokens exceeds the "
+                f"stages' max_len {self.embed.max_len}")
+
+        def decode(params, toks):
+            def one(i, tk):
+                logits = self._sequential_logits(params, tk)
+                nxt = jnp.argmax(logits[:, i - 1, :], axis=-1)
+                return tk.at[:, i].set(nxt.astype(jnp.int32))
+
+            return lax.fori_loop(p_len, total, one, toks)
+
+        toks0 = jnp.zeros((prompt.shape[0], total), jnp.int32)
+        toks0 = toks0.at[:, :p_len].set(prompt)
+        return jax.device_get(jax.jit(decode)(state.params, toks0))

@@ -148,6 +148,14 @@ class _Experiment:
     name: str
 
 
+def _is_pipeline(engine) -> bool:
+    """Pipeline engines have no monolithic ``model`` — params are stacked
+    per 'pipe' stage — so sampling/eval paths branch on the engine type."""
+    from distributed_tensorflow_tpu.engines.pipeline import PipelineEngine
+
+    return isinstance(engine, PipelineEngine)
+
+
 def _setup(config: ExperimentConfig) -> _Experiment:
     # the z-loss is applied by the MoE-aware engines: the -ep paths, and
     # the tp×sp composite when the model carries MoE blocks
@@ -161,13 +169,9 @@ def _setup(config: ExperimentConfig) -> _Experiment:
             "without --expert-parallel > 1 (or a tp×sp composite with "
             "--model-arg moe_experts=N) it would be silently ignored")
     if config.sample_tokens:
-        if config.pipeline_parallel > 1:
-            raise ValueError(
-                "--sample needs the whole model's params in one tree; the "
-                "pipeline engines stack params per 'pipe' stage (the "
-                "embedding lives only in stage 0), so post-train sampling "
-                "is unavailable under --pipeline-parallel — checkpoint and "
-                "sample in a non-pipeline run instead")
+        # pipeline runs sample too (sequential-forward decode over the
+        # pipe-stacked stages, engines/pipeline.py generate); family/shape
+        # specifics are checked post-setup in _validate_sampling
         if config.model_fn is None and config.model not in _LM_MODELS:
             raise ValueError(
                 f"--sample decodes autoregressively and needs a causal LM "
@@ -1248,25 +1252,36 @@ def _validate_sampling(config: ExperimentConfig, ex: _Experiment,
     under --max-restarts it would be caught by run_with_recovery as a
     restartable crash and re-train up to max_restarts more times, failing
     identically after each."""
-    from distributed_tensorflow_tpu.models.gpt import GPTLM
+    from distributed_tensorflow_tpu.models.gpt import GPTLM, GPTPipeEmbed
 
     if config.sample_tokens < 0:
         raise ValueError(
             f"--sample must be positive, got {config.sample_tokens}")
-    model = ex.engine.model
-    if not isinstance(model, GPTLM):
-        raise ValueError(
-            f"--sample requires the GPT causal LM; the resolved model is "
-            f"{type(model).__name__}")
+    if _is_pipeline(ex.engine):
+        # pipeline runs sample via the engine's sequential-forward decode
+        # (engines/pipeline.py generate) — GPT stage families only
+        if not isinstance(ex.engine.embed, GPTPipeEmbed):
+            raise ValueError(
+                f"--sample under --pipeline-parallel needs GPT decoder "
+                f"stages (vocab-head output); this run's embed stage is "
+                f"{type(ex.engine.embed).__name__}")
+        max_len = ex.engine.embed.max_len
+    else:
+        model = ex.engine.model
+        if not isinstance(model, GPTLM):
+            raise ValueError(
+                f"--sample requires the GPT causal LM; the resolved model "
+                f"is {type(model).__name__}")
+        max_len = model.max_len
     plen = config.sample_prompt_len
     if plen < 1 or plen > test_ds.x.shape[1]:
         raise ValueError(
             f"--sample-prompt-len {plen} outside the test sequences' "
             f"length {test_ds.x.shape[1]}")
-    if plen + config.sample_tokens > model.max_len:
+    if plen + config.sample_tokens > max_len:
         raise ValueError(
             f"--sample-prompt-len {plen} + --sample {config.sample_tokens} "
-            f"exceeds the model's cache capacity max_len={model.max_len}")
+            f"exceeds the model's capacity max_len={max_len}")
     n_prompts = ex.mesh.shape.get(meshlib.DATA_AXIS, 1)
     if len(test_ds.x) < n_prompts:
         raise ValueError(
@@ -1287,18 +1302,31 @@ def _sample_from_state(config: ExperimentConfig, ex: _Experiment, state,
     the final params — reproducible evidence of what the model learned,
     not a dice roll.  Engines whose state stacks per-device copies
     (async/gossip) are averaged first via their ``eval_params`` — the same
-    consensus model their evaluation uses.  Arguments were validated
-    pre-train (_validate_sampling)."""
+    consensus model their evaluation uses.  Pipeline engines decode via
+    their sequential-forward ``generate`` (engines/pipeline.py) — stage
+    params stay pipe-stacked; there is no KV cache to thread through the
+    schedule.  Arguments were validated pre-train (_validate_sampling)."""
     from distributed_tensorflow_tpu.models.gpt import generate
 
-    get_params = getattr(ex.engine, "eval_params", None)
-    params = (get_params(state) if get_params is not None else state.params)
     n_prompts = ex.mesh.shape.get(meshlib.DATA_AXIS, 1)
     prompts = np.asarray(test_ds.x[:n_prompts, :config.sample_prompt_len],
                          dtype=np.int32)
-    mesh = ex.mesh if ex.mesh.devices.size > 1 else None
-    toks = np.asarray(generate(ex.engine.model, params, prompts,
-                               config.sample_tokens, greedy=True, mesh=mesh))
+    if _is_pipeline(ex.engine):
+        # engine.generate returns prompt+continuation; slice to the
+        # continuation so 'samples' has ONE schema — (B, N) decoded
+        # tokens — regardless of engine (models/gpt.py generate already
+        # returns continuations only)
+        full = np.asarray(ex.engine.generate(state, prompts,
+                                             config.sample_tokens))
+        toks = full[:, config.sample_prompt_len:]
+    else:
+        get_params = getattr(ex.engine, "eval_params", None)
+        params = (get_params(state) if get_params is not None
+                  else state.params)
+        mesh = ex.mesh if ex.mesh.devices.size > 1 else None
+        toks = np.asarray(generate(ex.engine.model, params, prompts,
+                                   config.sample_tokens, greedy=True,
+                                   mesh=mesh))
     return {
         "sample_prompts": prompts.tolist(),
         "samples": toks.tolist(),
