@@ -711,11 +711,98 @@ def bench_moe(batch: int = 8, seq_len: int = 1024, vocab: int = 16384,
     }))
 
 
+def bench_decode(batch: int = 8, prompt_len: int = 32, vocab: int = 16384,
+                 hidden: int = 512, layers: int = 8, heads: int = 8,
+                 ffn: int = 2048) -> None:
+    """Inference: steady-state KV-cache decode throughput of the --lm
+    flagship config (models/gpt.py ``generate`` path — the compiled
+    prefill+decode scan).
+
+    Protocol: the sampler compiles once per decode length; two lengths
+    (64 / 576 new tokens, same prompt) are timed and DIFFERENCED, so the
+    prefill, dispatch, and host↔device overhead cancel and the quotient is
+    the marginal per-token decode step.  Decode is HBM-bandwidth-bound
+    (every step reads all weights to emit B tokens), so alongside
+    tokens/sec the line reports the achieved weight-streaming bandwidth
+    params_bytes × steps/sec — comparable against the chip's HBM spec."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models import create_model
+    from distributed_tensorflow_tpu.models.gpt import generate as gpt_generate
+
+    def note(msg):
+        print(f"[bench --decode] {msg}", file=sys.stderr, flush=True)
+
+    short, long = 64, 576
+    max_len = prompt_len + long
+    model = create_model("gpt", num_classes=vocab, hidden=hidden,
+                         layers=layers, heads=heads, ffn=ffn,
+                         max_len=max_len, dropout_rate=0.0,
+                         dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, vocab, (batch, prompt_len)),
+                         jnp.int32)
+    t0 = time.perf_counter()
+    params = jax.jit(lambda k: model.init(k, prompt, train=False))(
+        jax.random.key(0))["params"]
+    _sync(params)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    note(f"init done in {time.perf_counter() - t0:.0f}s "
+         f"({n_params / 1e6:.1f}M params)")
+
+    # the public sampling entry: its _compiled_sampler is lru-cached per
+    # (model config, length, mode), so after these warm-ups every timed
+    # call below reuses the same two compiled prefill+decode programs
+    for n_new in (short, long):
+        t0 = time.perf_counter()
+        _sync(gpt_generate(model, params, prompt, n_new, greedy=True))
+        note(f"decode({n_new}) compiled+ran in "
+             f"{time.perf_counter() - t0:.0f}s")
+
+    rates = []
+    for rep in range(REPEATS):
+        t = {}
+        for n_new in (short, long):
+            t0 = time.perf_counter()
+            _sync(gpt_generate(model, params, prompt, n_new, greedy=True))
+            t[n_new] = time.perf_counter() - t0
+        per_step = (t[long] - t[short]) / (long - short)
+        rates.append(batch / per_step)
+        note(f"rep {rep}: {rates[-1] / 1e3:.2f}k tokens/s, "
+             f"{per_step * 1e3:.3f} ms/step")
+    med, spread = _median_spread(rates)
+    steps_per_sec = med / batch
+    # weights stream once per decode STEP (all B rows share the read);
+    # params are f32 in HBM (cast to bf16 at use)
+    gbps = n_params * 4 * steps_per_sec / 1e9
+    print(json.dumps({
+        "metric": "gpt_lm_decode_tokens_per_sec_per_chip",
+        "value": round(med, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+        "method": f"differenced decode scans {long}-{short}, "
+                  f"median of {REPEATS}",
+        "spread": round(spread, 4),
+        "ms_per_step": round(1e3 / steps_per_sec, 3),
+        "achieved_weight_stream_GBps": round(gbps, 1),
+        "params_millions": round(n_params / 1e6, 1),
+        "config": {"batch": batch, "prompt_len": prompt_len,
+                   "vocab": vocab, "hidden": hidden, "layers": layers,
+                   "heads": heads, "ffn": ffn, "dtype": "bfloat16",
+                   "greedy": True},
+        "device": jax.devices()[0].device_kind,
+        "n_devices": 1,
+        "synthetic": True,
+    }))
+
+
 _MODE_METRICS = {
     "stream": "mnist_cnn_stream_examples_per_sec",
     "attention": "attention_fwd_bwd_step_ms",
     "lm": "gpt_lm_sync_tokens_per_sec_per_chip",
     "moe": "gpt_moe_sync_tokens_per_sec_per_chip",
+    "decode": "gpt_lm_decode_tokens_per_sec_per_chip",
     "default": "mnist_cnn_sync_examples_per_sec_per_chip",
 }
 
@@ -731,12 +818,16 @@ def main() -> None:
     p.add_argument("--moe", action="store_true",
                    help="MoE-FFN vs dense-FFN GPT throughput (router + "
                         "dispatch overhead at matched active FLOPs)")
+    p.add_argument("--decode", action="store_true",
+                   help="KV-cache decode throughput (tokens/sec + achieved "
+                        "weight-streaming bandwidth) of the --lm config")
     p.add_argument("--no-probe", action="store_true",
                    help="skip the backend-availability probe (saves ~10s "
                         "when the backend is known-good)")
     args = p.parse_args()
     mode = ("stream" if args.stream else "attention" if args.attention
-            else "lm" if args.lm else "moe" if args.moe else "default")
+            else "lm" if args.lm else "moe" if args.moe
+            else "decode" if args.decode else "default")
     metric = _MODE_METRICS[mode]
     if not args.no_probe:
         ensure_backend(metric)
@@ -749,6 +840,8 @@ def main() -> None:
             bench_lm()
         elif mode == "moe":
             bench_moe()
+        elif mode == "decode":
+            bench_decode()
         else:
             bench_throughput()
     except Exception as e:  # noqa: BLE001 — the artifact must stay parsable
