@@ -130,12 +130,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shard sequences over this many devices (long-context "
                         "mode; requires a sequence model, e.g. --model bert_tiny)")
     p.add_argument("--attention", default="ring",
-                   choices=["ring", "ring_flash", "ulysses", "flash"],
-                   help="attention strategy: ring/ring_flash/ulysses shard "
-                        "the sequence over -sp devices (ring_flash = ring "
-                        "schedule with the Pallas flash kernel as local "
-                        "math); flash = single-device Pallas kernel, valid "
-                        "only with -sp 1 (sequence models)")
+                   choices=["ring", "ring_flash", "ulysses", "ulysses_flash", "flash"],
+                   help="attention strategy: ring/ring_flash/ulysses/"
+                        "ulysses_flash shard the sequence over -sp devices "
+                        "(the *_flash variants run the Pallas flash kernel "
+                        "as the local math inside the ring / Ulysses "
+                        "communication schedule); flash = single-device "
+                        "Pallas kernel, valid only with -sp 1 (sequence "
+                        "models)")
     p.add_argument("--positional", default="learned",
                    choices=["learned", "rope"],
                    help="GPT position encoding: learned table | RoPE "

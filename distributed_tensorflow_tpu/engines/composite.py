@@ -42,8 +42,9 @@ class CompositeEngine(Engine):
     """Sync training over a ('data', 'model', 'seq'[, 'expert']) mesh.
 
     Any axis may have size 1; ``seq`` > 1 requires a model whose
-    ``attention_impl`` is 'ring', 'ring_flash' or 'ulysses' (dense attention on
-    seq-sharded activations would attend within local blocks only).
+    ``attention_impl`` is 'ring', 'ring_flash', 'ulysses' or 'ulysses_flash'
+    (dense attention on seq-sharded activations would attend within local
+    blocks only).
 
     An ``expert`` axis (ep×sp — the long-context MoE shape) requires a
     model with MoE blocks carrying ``with_partitioning('expert', ...)``
@@ -100,11 +101,14 @@ class CompositeEngine(Engine):
         self.seq_n = mesh.shape.get(meshlib.SEQ_AXIS, 1)
         self.tp_n = mesh.shape.get(meshlib.MODEL_AXIS, 1)
         impl = getattr(model, "attention_impl", "dense")
-        if self.seq_n > 1 and impl not in ("ring", "ring_flash", "ulysses"):
+        if self.seq_n > 1 and impl not in ("ring", "ring_flash", "ulysses",
+                                           "ulysses_flash"):
             raise ValueError(
                 f"seq axis size {self.seq_n} needs attention_impl 'ring', "
-                f"'ring_flash' or 'ulysses', got '{impl}'")
-        if self.seq_n == 1 and impl in ("ring", "ring_flash", "ulysses"):
+                f"'ring_flash', 'ulysses' or 'ulysses_flash', got "
+                f"'{impl}'")
+        if self.seq_n == 1 and impl in ("ring", "ring_flash", "ulysses",
+                                        "ulysses_flash"):
             # degenerate seq axis: the manual collectives would reference an
             # unbound axis in the plain-jit path — swap in the dense twin
             # (identical params/math on an unsharded sequence)
@@ -123,7 +127,8 @@ class CompositeEngine(Engine):
         shardings read from the model's partitioning annotations."""
         twin = self.model
         if getattr(twin, "attention_impl", "dense") in ("ring", "ring_flash",
-                                                       "ulysses"):
+                                                        "ulysses",
+                                                        "ulysses_flash"):
             twin = twin.clone(attention_impl="dense")
         return self._init_partitioned_state(rng, sample_x, init_model=twin)
 

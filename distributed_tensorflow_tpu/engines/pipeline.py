@@ -335,7 +335,7 @@ class PipelineEngine(Engine):
         if getattr(block, "seq_axis", None) is not None:
             block = block.clone(seq_axis=None)
         if getattr(block, "attention_impl", "dense") in (
-                "ring", "ring_flash", "ulysses"):
+                "ring", "ring_flash", "ulysses", "ulysses_flash"):
             block = block.clone(attention_impl="dense")
         return embed, block
 

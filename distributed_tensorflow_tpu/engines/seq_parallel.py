@@ -36,7 +36,8 @@ class SeqParallelEngine(Engine):
     """Data×sequence parallel sync training.
 
     ``mesh`` must have axes ('data', 'seq'); the model's ``attention_impl``
-    must be 'ring', 'ring_flash' or 'ulysses' with ``seq_axis='seq'``.
+    must be 'ring', 'ring_flash', 'ulysses' or 'ulysses_flash' with
+    ``seq_axis='seq'``.
     """
 
     seq_axis = meshlib.SEQ_AXIS
@@ -49,11 +50,12 @@ class SeqParallelEngine(Engine):
         if set(mesh.axis_names) != {meshlib.DATA_AXIS, meshlib.SEQ_AXIS}:
             raise ValueError(f"mesh axes must be (data, seq), got {mesh.axis_names}")
         if getattr(model, "attention_impl", None) not in (
-                "ring", "ring_flash", "ulysses"):
+                "ring", "ring_flash", "ulysses", "ulysses_flash"):
             raise ValueError(
-                "SeqParallelEngine needs a model with attention_impl 'ring', "
-                "'ring_flash' or 'ulysses' — dense attention on sequence-sharded activations "
-                "would silently attend within local blocks only")
+                "SeqParallelEngine needs a model with attention_impl "
+                "'ring', 'ring_flash', 'ulysses' or 'ulysses_flash' — "
+                "dense attention on sequence-sharded activations would "
+                "silently attend within local blocks only")
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self.grad_accum = grad_accum

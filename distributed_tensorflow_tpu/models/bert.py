@@ -19,6 +19,10 @@ Attention is pluggable (``attention_impl``):
                  (BASELINE.md §attention).  Same contract as 'ring'.
   'ulysses'    — all-to-all head-parallel attention over 'seq'; same
                  contract, plus num_heads % seq_axis_size == 0.
+  'ulysses_flash' — Ulysses reshard with the Pallas flash kernel as the
+                 local math (each device holds the FULL sequence for H/n
+                 heads after the all-to-all — exactly the single-device
+                 flash case).  Same contract as 'ulysses'.
 
 Input is int32 token ids (B, L_local); 0 is the padding id and is masked out
 of attention.  The classification head reads the [CLS] position (global
@@ -42,7 +46,8 @@ import jax.numpy as jnp
 from distributed_tensorflow_tpu.parallel import collectives as coll
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 from distributed_tensorflow_tpu.parallel.ring_attention import (
-    dense_attention, ring_attention, ring_flash_attention, ulysses_attention)
+    dense_attention, ring_attention, ring_flash_attention,
+    ulysses_attention, ulysses_flash_attention)
 
 
 def _part(init, spec, enabled: bool):
@@ -91,6 +96,9 @@ class SelfAttention(nn.Module):
                                        kv_mask=pad_mask)
         elif self.attention_impl == "ulysses":
             out = ulysses_attention(q, k, v, axis=self.seq_axis, kv_mask=pad_mask)
+        elif self.attention_impl == "ulysses_flash":
+            out = ulysses_flash_attention(q, k, v, axis=self.seq_axis,
+                                          kv_mask=pad_mask)
         elif self.attention_impl == "flash":
             from distributed_tensorflow_tpu.ops import flash_attention
             out = flash_attention(q, k, v, kv_mask=pad_mask)
@@ -225,7 +233,7 @@ class BertTinyClassifier(nn.Module):
     @nn.compact
     def __call__(self, token_ids, train: bool = False):
         seq_parallel = self.attention_impl in ("ring", "ring_flash",
-                                               "ulysses")
+                                               "ulysses", "ulysses_flash")
         pad_mask = (token_ids > 0).astype(self.dtype)
         lq = token_ids.shape[1]
         # nn.Embed clamps out-of-range gathers silently — fail loudly instead

@@ -45,7 +45,8 @@ import jax.numpy as jnp
 from distributed_tensorflow_tpu.parallel import collectives as coll
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 from distributed_tensorflow_tpu.parallel.ring_attention import (
-    dense_attention, ring_attention, ring_flash_attention, ulysses_attention)
+    dense_attention, ring_attention, ring_flash_attention,
+    ulysses_attention, ulysses_flash_attention)
 
 
 def _part(init, spec, enabled: bool):
@@ -191,6 +192,9 @@ class CausalSelfAttention(nn.Module):
         elif self.attention_impl == "ulysses":
             out = ulysses_attention(q, widen(k), widen(v),
                                     axis=self.seq_axis, causal=True)
+        elif self.attention_impl == "ulysses_flash":
+            out = ulysses_flash_attention(q, widen(k), widen(v),
+                                          axis=self.seq_axis, causal=True)
         elif self.attention_impl == "flash":
             from distributed_tensorflow_tpu.ops import flash_attention
             out = flash_attention(q, widen(k), widen(v), causal=True)
@@ -319,7 +323,7 @@ class GPTLM(nn.Module):
     @nn.compact
     def __call__(self, token_ids, train: bool = False):
         seq_parallel = self.attention_impl in ("ring", "ring_flash",
-                                               "ulysses")
+                                               "ulysses", "ulysses_flash")
         lq = token_ids.shape[1]
         if self.decode:
             if seq_parallel:

@@ -128,15 +128,12 @@ def ring_attention(q, k, v, axis: str, causal: bool = False,
     return acc / l.transpose(0, 2, 1)[..., None]
 
 
-def ulysses_attention(q, k, v, axis: str, causal: bool = False,
-                      scale: float | None = None, kv_mask=None):
-    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
-
-    Reshard (B, L/n, H, D) → (B, L, H/n, D) with one `all_to_all`, run dense
-    attention on the full sequence for H/n heads, reshard back.  Two
-    all-to-alls per tensor vs n ppermute hops for ring — better when H
-    divides well and the full-sequence scores fit in memory.
-    """
+def _ulysses(q, k, v, axis: str, causal: bool, scale, kv_mask, attn_fn):
+    """Shared Ulysses reshard: (B, L/n, H, D) → (B, L, H/n, D) with one
+    `all_to_all`, run ``attn_fn`` on the full sequence for H/n heads,
+    reshard back.  Two all-to-alls per tensor vs n ppermute hops for ring —
+    better when H divides well and the local math handles the full
+    sequence."""
     n = lax.axis_size(axis)
     if q.shape[2] % n != 0:
         raise ValueError(f"num_heads {q.shape[2]} not divisible by axis size {n}")
@@ -152,9 +149,32 @@ def ulysses_attention(q, k, v, axis: str, causal: bool = False,
     full_mask = None
     if kv_mask is not None:  # (B, L/n) → (B, L): every device needs all keys
         full_mask = lax.all_gather(kv_mask, axis_name=axis, axis=1, tiled=True)
-    out = dense_attention(to_heads(q), to_heads(k), to_heads(v),
-                          causal=causal, scale=scale, kv_mask=full_mask)
+    out = attn_fn(to_heads(q), to_heads(k), to_heads(v),
+                  causal=causal, scale=scale, kv_mask=full_mask)
     return to_seq(out)
+
+
+def ulysses_attention(q, k, v, axis: str, causal: bool = False,
+                      scale: float | None = None, kv_mask=None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism with XLA
+    dense local attention — see ``_ulysses``."""
+    return _ulysses(q, k, v, axis, causal, scale, kv_mask, dense_attention)
+
+
+def ulysses_flash_attention(q, k, v, axis: str, causal: bool = False,
+                            scale: float | None = None, kv_mask=None):
+    """Ulysses reshard with the Pallas flash kernel as the local math.
+
+    After the all-to-all each device holds the FULL sequence for H/n
+    heads — exactly the single-device flash case, so the fused kernel
+    (ops/flash_attention.py: on-chip tiles, never materializes the (L, L)
+    scores, causal block skipping, custom-vjp backward) applies verbatim.
+    The communication pattern is identical to ``ulysses_attention``; only
+    the O(L²) local compute changes — the same relationship ring_flash
+    has to ring."""
+    from distributed_tensorflow_tpu.ops.flash_attention import flash_attention
+
+    return _ulysses(q, k, v, axis, causal, scale, kv_mask, flash_attention)
 
 
 # ---------------------------------------------------------------------------

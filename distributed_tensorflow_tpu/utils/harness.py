@@ -378,7 +378,7 @@ def _resolve_model(config: ExperimentConfig, num_classes: int):
         f"--model {config.model}")
     kw.update(forced)
     if config.model in _SEQUENCE_MODELS and config.attention_impl in (
-            "flash", "ring_flash"):
+            "flash", "ring_flash", "ulysses_flash"):
         # the Pallas kernel is valid without a seq axis (single-device
         # blockwise attention); ring_flash degrades to it honestly — the
         # user asked for the flash kernel, and at sp==1 the ring schedule

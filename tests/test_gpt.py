@@ -135,7 +135,8 @@ def test_gpt_tensor_parallel_matches_single_device(lm_data):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
+@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses",
+                                  "ulysses_flash"])
 def test_gpt_seq_parallel_matches_single_device(lm_data, impl):
     """Causal LM under (data=2, seq=4): per-token logits VARY over 'seq'
     (unlike BERT's [CLS] broadcast), exercising the engine's LM loss path —
@@ -144,7 +145,7 @@ def test_gpt_seq_parallel_matches_single_device(lm_data, impl):
 
     tr, _ = lm_data
     x, y = tr.x[:16], tr.y[:16]
-    heads = 4 if impl == "ulysses" else 2
+    heads = 4 if impl.startswith("ulysses") else 2
 
     eng1 = SyncEngine(tiny_gpt("dense", heads=heads),
                       optimizer=optax.sgd(0.1), mesh=meshlib.create_mesh(1))

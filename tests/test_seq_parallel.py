@@ -68,7 +68,11 @@ def test_seq_parallel_matches_single_device(text_data):
 
 
 @pytest.mark.slow
-def test_seq_parallel_ulysses_matches_single_device(text_data):
+@pytest.mark.parametrize("impl", ["ulysses", "ulysses_flash"])
+def test_seq_parallel_ulysses_matches_single_device(text_data, impl):
+    """Both Ulysses local-math variants (XLA dense / Pallas flash kernel)
+    must reproduce single-device dense training — the flash variant also
+    exercises the all-gathered pad mask through the kernel's kv_mask."""
     import optax
 
     tr, _ = text_data
@@ -80,7 +84,7 @@ def test_seq_parallel_ulysses_matches_single_device(text_data):
     xs, ys = eng1.shard_batch(x, y)
     s1, m1 = eng1.step(s1, xs, ys)
 
-    eng8 = SeqParallelEngine(tiny_bert("ulysses", heads=4),
+    eng8 = SeqParallelEngine(tiny_bert(impl, heads=4),
                              optimizer=optax.sgd(0.1), mesh=seq_mesh(2, 4))
     s8 = eng8.init_state(jax.random.key(0), x)
     xs, ys = eng8.shard_batch(x, y)
