@@ -72,7 +72,8 @@ class ExperimentConfig:
     target_accuracy: float | None = None   # e.g. 0.97 for steps-to-97%
     seq_parallel: int = 1                  # >1: shard sequences over a 'seq'
                                            # mesh axis (long-context mode)
-    attention_impl: str = "ring"           # ring | ring_flash | ulysses (when
+    attention_impl: str = "ring"           # ring | ring_flash | ulysses |
+                                           # ulysses_flash (when
                                            # seq_parallel>1); flash (Pallas
                                            # kernel) when seq_parallel==1
     positional: str = "learned"            # GPT positions: learned | rope
@@ -499,8 +500,8 @@ def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
     if config.attention_impl == "flash":
         raise ValueError(
             "--attention flash is the single-device Pallas kernel; with "
-            "--seq-parallel > 1 use ring_flash (the ring schedule with the "
-            "flash kernel as local math)")
+            "--seq-parallel > 1 use ring_flash or ulysses_flash (the ring "
+            "/ Ulysses schedules with the flash kernel as local math)")
     mesh, dp = _split_mesh(config, config.seq_parallel, "seq_parallel",
                            meshlib.SEQ_AXIS, grad_accum_ok=True)
     train_ds, test_ds = _load_data(config)
@@ -866,7 +867,7 @@ def _setup_pipeline_ep(config: ExperimentConfig, tp: int = 1,
     if sp > 1 and config.attention_impl == "flash":
         raise ValueError(
             "--attention flash is the single-device kernel; with "
-            "--seq-parallel use ring or ring_flash")
+            "--seq-parallel use ring, ring_flash or ulysses_flash")
     if config.num_experts % config.expert_parallel:
         raise ValueError(
             f"num_experts {config.num_experts} not divisible by "
@@ -1005,7 +1006,7 @@ def _setup_pipeline_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
     if config.attention_impl == "flash":
         raise ValueError(
             "--attention flash is the single-device kernel; with "
-            "--seq-parallel use ring or ring_flash")
+            "--seq-parallel use ring, ring_flash or ulysses_flash")
     extra = [(tp, meshlib.MODEL_AXIS)] if tp > 1 else []
     mesh, dp = _split_mesh(config, config.pipeline_parallel, mode,
                            meshlib.PIPE_AXIS,
@@ -1062,7 +1063,7 @@ def _setup_expert_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
     if config.attention_impl == "flash":
         raise ValueError(
             "--attention flash is the single-device kernel; with "
-            "--seq-parallel use ring, ring_flash or ulysses")
+            "--seq-parallel use ring, ring_flash, ulysses or ulysses_flash")
     if config.num_experts % config.expert_parallel:
         raise ValueError(
             f"num_experts {config.num_experts} not divisible by "
