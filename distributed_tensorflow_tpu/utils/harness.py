@@ -867,7 +867,8 @@ def _setup_pipeline_ep(config: ExperimentConfig, tp: int = 1,
     if sp > 1 and config.attention_impl == "flash":
         raise ValueError(
             "--attention flash is the single-device kernel; with "
-            "--seq-parallel use ring, ring_flash or ulysses_flash")
+            "--seq-parallel use ring, ring_flash, ulysses or "
+            "ulysses_flash")
     if config.num_experts % config.expert_parallel:
         raise ValueError(
             f"num_experts {config.num_experts} not divisible by "
@@ -1006,7 +1007,8 @@ def _setup_pipeline_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
     if config.attention_impl == "flash":
         raise ValueError(
             "--attention flash is the single-device kernel; with "
-            "--seq-parallel use ring, ring_flash or ulysses_flash")
+            "--seq-parallel use ring, ring_flash, ulysses or "
+            "ulysses_flash")
     extra = [(tp, meshlib.MODEL_AXIS)] if tp > 1 else []
     mesh, dp = _split_mesh(config, config.pipeline_parallel, mode,
                            meshlib.PIPE_AXIS,

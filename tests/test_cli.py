@@ -244,3 +244,41 @@ def test_model_arg_reserved_key_rejected_cleanly():
                              dataset="lm_synth", n_devices=8,
                              seq_parallel=2,
                              model_args={"attention_impl": "ulysses"}))
+
+
+@pytest.mark.slow
+def test_package_import_honors_platform_env():
+    """The package __init__ re-asserts JAX_PLATFORMS/JAX_PLATFORM_NAME over
+    config state a preloaded plugin may have forced (the sitecustomize
+    hang: importing jax alone leaves the forced platform in place; every
+    framework entry path imports this package before touching devices).
+    Precedence matches JAX's own: non-empty JAX_PLATFORMS wins, the
+    deprecated JAX_PLATFORM_NAME is the fallback."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    script = (
+        "import jax\n"
+        # simulate a sitecustomize-style forced platform before import
+        "jax.config.update('jax_platforms', 'bogus_accel,cpu')\n"
+        "import distributed_tensorflow_tpu\n"
+        "print('PLATFORMS=' + str(jax.config.jax_platforms))\n"
+    )
+    for env_extra, want in (
+            ({"JAX_PLATFORMS": "cpu", "JAX_PLATFORM_NAME": "tpu"}, "cpu"),
+            ({"JAX_PLATFORMS": "", "JAX_PLATFORM_NAME": "cpu"}, "cpu"),
+            # neither set: the forced value must be left alone (no-op)
+            ({"JAX_PLATFORMS": "", "JAX_PLATFORM_NAME": ""},
+             "bogus_accel,cpu"),
+    ):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("JAX_PLATFORMS", "JAX_PLATFORM_NAME")}
+        env.update({k: v for k, v in env_extra.items() if v})
+        env["PYTHONPATH"] = str(repo) + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run([sys.executable, "-c", script], env=env,
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert f"PLATFORMS={want}" in out.stdout, (env_extra, out.stdout)
