@@ -160,8 +160,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "per prompt from the final params (KV-cache "
                         "sampler, multi-device over the run's mesh; under "
                         "--pipeline-parallel a sequential-forward decode "
-                        "over the pipe-stacked stages) and record "
-                        "prompts+continuations in the summary")
+                        "over the pipe-stacked stages — dense-FFN stages "
+                        "only, MoE stages are rejected with the routing-"
+                        "capacity reason) and record prompts+continuations "
+                        "in the summary")
     p.add_argument("--sample-prompt-len", type=int, default=8,
                    help="prompt tokens taken from the test split per "
                         "sampled row (--sample)")

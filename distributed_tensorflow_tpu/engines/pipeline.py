@@ -549,25 +549,27 @@ class PipelineEngine(Engine):
                                         to="varying"),
                     h0)
                 _, ys = lax.scan(tick, buf0, jnp.arange(M + S - 1))
+                # losses nonzero only on the last stage; scale so the
+                # implicit psum over BOTH axes at the AD boundary yields
+                # the global batch mean (same mechanism as engines/sync.py).
+                # The router aux/z sums ride the SAME scale: the pipe psum
+                # turns each stage's local router sum into the sum over ALL
+                # the model's routers (router_losses is a sum over a
+                # stage's routers — matching the composite's
+                # sum-over-blocks objective, engines/composite.py), while
+                # /(M·n_data·sp) averages over the microbatch × data-shard
+                # × seq-block applications.
                 if moe:
                     losses, accs, ws, auxs, zs, ovfs = ys
+                    local_sum = (losses.sum() + aux_w * auxs.sum()
+                                 + z_w * zs.sum())
+                    ovf_sum = ovfs.sum()
                 else:
                     losses, accs, ws = ys
-                    auxs = zs = ovfs = jnp.zeros_like(losses)
-                # nonzero only on the last stage; scale so the implicit psum
-                # over BOTH axes at the AD boundary yields the global batch
-                # mean (same mechanism as engines/sync.py).  The router
-                # aux/z sums ride the SAME scale: the pipe psum turns each
-                # stage's local router sum into the sum over ALL the
-                # model's routers (router_losses is a sum over a stage's
-                # routers — matching the composite's sum-over-blocks
-                # objective, engines/composite.py), while /(M·n_data·sp)
-                # averages over the microbatch × data-shard × seq-block
-                # applications.
-                local_sum = losses.sum() + aux_w * auxs.sum() + z_w * zs.sum()
+                    local_sum = losses.sum()
+                    ovf_sum = jnp.zeros((), jnp.float32)
                 scaled = local_sum / (M * n_data * sp)
-                return scaled, (losses.sum(), accs.sum(), ws.sum(),
-                                ovfs.sum())
+                return scaled, (losses.sum(), accs.sum(), ws.sum(), ovf_sum)
 
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
             ((_, (loss_sum, acc_sum, w_sum, ovf_sum)),
@@ -852,7 +854,12 @@ class PipelineEngine(Engine):
 
         ``prompt``: (B, P) int32 token ids.  Returns (B, P + N) int32 —
         prompt followed by the greedy continuation.  GPT stage families
-        only (the BERT stages end in a classifier, not a vocab head)."""
+        only (the BERT stages end in a classifier, not a vocab head), and
+        DENSE-FFN stages only: the padding-invisibility argument is a
+        causal-attention property — capacity-limited MoE routing flattens
+        ALL positions into its dispatch (capacity and slot priority depend
+        on the not-yet-decoded zeros), so a fixed-length forward over a
+        partially-filled buffer is not the greedy continuation there."""
         from distributed_tensorflow_tpu.models.gpt import GPTPipeEmbed
 
         if not isinstance(self.embed, GPTPipeEmbed):
@@ -860,6 +867,15 @@ class PipelineEngine(Engine):
                 f"generate needs GPT decoder stages (vocab-head output); "
                 f"this engine's embed stage is "
                 f"{type(self.embed).__name__}")
+        if self.moe:
+            raise ValueError(
+                "generate does not support MoE stage blocks: the routers' "
+                "capacity-limited dispatch sees every position of the "
+                "fixed-length buffer, so the zero padding claims expert "
+                "capacity and shifts routing — the decode would not be the "
+                "true greedy continuation.  Sample from a dense-FFN "
+                "pipeline run, or train MoE without -pp and use the "
+                "KV-cache sampler")
         prompt = jnp.asarray(prompt, jnp.int32)
         if prompt.ndim != 2:
             raise ValueError(f"prompt must be (batch, len), got "
@@ -871,14 +887,23 @@ class PipelineEngine(Engine):
                 f"prompt {p_len} + {max_new_tokens} new tokens exceeds the "
                 f"stages' max_len {self.embed.max_len}")
 
-        def decode(params, toks):
-            def one(i, tk):
-                logits = self._sequential_logits(params, tk)
-                nxt = jnp.argmax(logits[:, i - 1, :], axis=-1)
-                return tk.at[:, i].set(nxt.astype(jnp.int32))
+        # one compiled program per (prompt_len, total) — repeated sampling
+        # (per-eval-batch loops) reuses it instead of re-jitting, the same
+        # reason models/gpt.py lru-caches its compiled KV sampler
+        if not hasattr(self, "_decode_cache"):
+            self._decode_cache = {}
+        key = (p_len, total)
+        if key not in self._decode_cache:
+            def decode(params, toks):
+                def one(i, tk):
+                    logits = self._sequential_logits(params, tk)
+                    nxt = jnp.argmax(logits[:, i - 1, :], axis=-1)
+                    return tk.at[:, i].set(nxt.astype(jnp.int32))
 
-            return lax.fori_loop(p_len, total, one, toks)
+                return lax.fori_loop(p_len, total, one, toks)
+
+            self._decode_cache[key] = jax.jit(decode)
 
         toks0 = jnp.zeros((prompt.shape[0], total), jnp.int32)
         toks0 = toks0.at[:, :p_len].set(prompt)
-        return jax.device_get(jax.jit(decode)(state.params, toks0))
+        return jax.device_get(self._decode_cache[key](state.params, toks0))

@@ -149,6 +149,17 @@ class _Experiment:
     name: str
 
 
+def _reject_flash_under_sp(config: ExperimentConfig) -> None:
+    """Every seq-sharded mode shares this rejection so the option list
+    cannot drift between modes (the seq-capable set is
+    ring / ring_flash / ulysses / ulysses_flash; 'flash' is the
+    single-device Pallas kernel)."""
+    if config.attention_impl == "flash":
+        raise ValueError(
+            "--attention flash is the single-device Pallas kernel; with "
+            "--seq-parallel use ring, ring_flash, ulysses or ulysses_flash")
+
+
 def _is_pipeline(engine) -> bool:
     """Pipeline engines have no monolithic ``model`` — params are stacked
     per 'pipe' stage — so sampling/eval paths branch on the engine type."""
@@ -497,11 +508,7 @@ def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
     them shard the sequence, the rest shard the batch."""
     from distributed_tensorflow_tpu.engines.seq_parallel import SeqParallelEngine
 
-    if config.attention_impl == "flash":
-        raise ValueError(
-            "--attention flash is the single-device Pallas kernel; with "
-            "--seq-parallel > 1 use ring_flash or ulysses_flash (the ring "
-            "/ Ulysses schedules with the flash kernel as local math)")
+    _reject_flash_under_sp(config)
     mesh, dp = _split_mesh(config, config.seq_parallel, "seq_parallel",
                            meshlib.SEQ_AXIS, grad_accum_ok=True)
     train_ds, test_ds = _load_data(config)
@@ -864,11 +871,8 @@ def _setup_pipeline_ep(config: ExperimentConfig, tp: int = 1,
             f"(got --model {config.model}); custom models pass stages "
             f"whose block carries moe_experts/partition_experts "
             f"(models/moe.py MoELayer) to PipelineEngine")
-    if sp > 1 and config.attention_impl == "flash":
-        raise ValueError(
-            "--attention flash is the single-device kernel; with "
-            "--seq-parallel use ring, ring_flash, ulysses or "
-            "ulysses_flash")
+    if sp > 1:
+        _reject_flash_under_sp(config)
     if config.num_experts % config.expert_parallel:
         raise ValueError(
             f"num_experts {config.num_experts} not divisible by "
@@ -1004,11 +1008,7 @@ def _setup_pipeline_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
             f"{mode} ships GPT decoder stages only "
             f"(got --model {config.model}); custom models pass seq-aware "
             f"stages to PipelineEngine directly")
-    if config.attention_impl == "flash":
-        raise ValueError(
-            "--attention flash is the single-device kernel; with "
-            "--seq-parallel use ring, ring_flash, ulysses or "
-            "ulysses_flash")
+    _reject_flash_under_sp(config)
     extra = [(tp, meshlib.MODEL_AXIS)] if tp > 1 else []
     mesh, dp = _split_mesh(config, config.pipeline_parallel, mode,
                            meshlib.PIPE_AXIS,
@@ -1062,10 +1062,7 @@ def _setup_expert_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
             f"models/gpt.py or models/bert.py); got --model {config.model} "
             f"— use --model gpt (--dataset lm_synth) or --model bert_tiny "
             f"(--dataset glue_synth)")
-    if config.attention_impl == "flash":
-        raise ValueError(
-            "--attention flash is the single-device kernel; with "
-            "--seq-parallel use ring, ring_flash, ulysses or ulysses_flash")
+    _reject_flash_under_sp(config)
     if config.num_experts % config.expert_parallel:
         raise ValueError(
             f"num_experts {config.num_experts} not divisible by "
@@ -1268,6 +1265,18 @@ def _validate_sampling(config: ExperimentConfig, ex: _Experiment,
                 f"--sample under --pipeline-parallel needs GPT decoder "
                 f"stages (vocab-head output); this run's embed stage is "
                 f"{type(ex.engine.embed).__name__}")
+        if ex.engine.moe:
+            # raised pre-train (a post-train raise would waste the run):
+            # the fixed-length decode's padding-invisibility argument is a
+            # causal-attention property — MoE routing's capacity-limited
+            # dispatch sees the zero padding (engines/pipeline.py generate)
+            raise ValueError(
+                "--sample is unavailable for MoE pipeline stages "
+                "(-pp with --num-experts): expert routing's capacity "
+                "depends on every buffer position, so the fixed-length "
+                "decode would not be the true greedy continuation — "
+                "sample a dense-FFN pipeline run, or train MoE without "
+                "-pp and use the KV-cache sampler")
         max_len = ex.engine.embed.max_len
     else:
         model = ex.engine.model

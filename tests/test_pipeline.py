@@ -1000,3 +1000,27 @@ def test_pipeline_sample_through_harness():
     prompts = np.asarray(summary["sample_prompts"])
     assert prompts.shape == (4, 6)
     assert samples.min() >= 0 and samples.max() < 64  # vocab-bounded
+
+
+def test_pipeline_generate_rejects_moe_stages():
+    """Capacity-limited routing sees the fixed-length buffer's zero
+    padding, so the decode would not be the true greedy continuation —
+    engine and harness both reject BEFORE any work."""
+    from distributed_tensorflow_tpu.models.gpt import gpt_pipeline_stages
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    eng = PipelineEngine(
+        microbatches=2, mesh=_pp_ep_mesh(),
+        stages=gpt_pipeline_stages(vocab_size=64, hidden=16, heads=2,
+                                   ffn=32, max_len=16, moe_experts=4,
+                                   partition_experts=True))
+    with pytest.raises(ValueError, match="MoE|capacity"):
+        eng.generate(None, np.zeros((1, 4), np.int32), 4)
+    # harness: rejected pre-train
+    with pytest.raises(ValueError, match="MoE pipeline"):
+        run(ExperimentConfig(
+            engine="sync", model="gpt", dataset="lm_synth", n_devices=8,
+            pipeline_parallel=2, expert_parallel=2, num_experts=4,
+            microbatches=2, batch_size=4, sample_tokens=4,
+            sample_prompt_len=4))
