@@ -50,7 +50,10 @@ def _honor_platform_env() -> None:
     if want:
         import jax
 
-        jax.config.update("jax_platforms", want)
+        # jax itself lowercases JAX_PLATFORM_NAME (xla_bridge) while
+        # jax_platforms lookups are case-sensitive — normalize so e.g.
+        # JAX_PLATFORM_NAME=CPU selects cpu instead of erroring
+        jax.config.update("jax_platforms", want.lower())
 
 
 _honor_platform_env()

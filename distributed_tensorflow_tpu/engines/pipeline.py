@@ -301,6 +301,7 @@ class PipelineEngine(Engine):
                                  if self.moe else None)
         self.n_stages = mesh.shape[meshlib.PIPE_AXIS]
         self.microbatches = microbatches
+        self._decode_cache = {}  # generate: jitted decode per length pair
         super().__init__(model=None, optimizer=optimizer, mesh=mesh,
                          learning_rate=learning_rate)
 
@@ -880,6 +881,9 @@ class PipelineEngine(Engine):
         if prompt.ndim != 2:
             raise ValueError(f"prompt must be (batch, len), got "
                              f"{prompt.shape}")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be positive, got {max_new_tokens}")
         p_len = prompt.shape[1]
         total = p_len + int(max_new_tokens)
         if total > self.embed.max_len:
@@ -890,8 +894,6 @@ class PipelineEngine(Engine):
         # one compiled program per (prompt_len, total) — repeated sampling
         # (per-eval-batch loops) reuses it instead of re-jitting, the same
         # reason models/gpt.py lru-caches its compiled KV sampler
-        if not hasattr(self, "_decode_cache"):
-            self._decode_cache = {}
         key = (p_len, total)
         if key not in self._decode_cache:
             def decode(params, toks):

@@ -663,20 +663,26 @@ def _reject_model_args(config: ExperimentConfig, mode: str) -> None:
 
 
 _STAGE_MODEL_ARGS = ("heads", "ffn", "layers_per_stage")
+_STAGE_MOE_ARGS = ("moe_capacity_factor",)  # the overflow monitor's advised
+                                            # remediation must be reachable
+                                            # from the CLI on pp×ep runs
 
 
-def _stage_model_args(config: ExperimentConfig, mode: str) -> dict:
+def _stage_model_args(config: ExperimentConfig, mode: str,
+                      moe: bool = False) -> dict:
     """--model-arg keys the BERT/GPT pipeline-stage families accept
     (VERDICT r3 #6: an 8-head or 2-layers-per-stage pipeline should not
     require Python).  Width still comes from --pipeline-hidden; everything
     else is either a dedicated flag (--kv-heads, --positional) or not a
-    per-stage knob — reject with the full picture."""
+    per-stage knob — reject with the full picture.  MoE stages (pp×ep)
+    additionally accept ``moe_capacity_factor``."""
+    allowed = _STAGE_MODEL_ARGS + (_STAGE_MOE_ARGS if moe else ())
     extra = dict(config.model_args or {})
-    bad = sorted(set(extra) - set(_STAGE_MODEL_ARGS))
+    bad = sorted(set(extra) - set(allowed))
     if bad:
         raise ValueError(
             f"--model-arg key(s) {bad} do not reach {mode} stage modules; "
-            f"stages accept {'/'.join(_STAGE_MODEL_ARGS)} via --model-arg, "
+            f"stages accept {'/'.join(allowed)} via --model-arg, "
             f"width via --pipeline-hidden, and K/V heads / positional "
             f"encoding via --kv-heads / --positional")
     return extra
@@ -696,7 +702,7 @@ def _pipeline_stages(config: ExperimentConfig, train_ds, test_ds, mode: str,
     heads/ffn/layers_per_stage`` size the stages (_stage_model_args)."""
     _require_token_data(train_ds, config, mode)
     dtype = modellib.resolve_dtype(config.dtype)
-    extra = _stage_model_args(config, mode)
+    extra = _stage_model_args(config, mode, moe=moe)
     if moe:
         extra.update(moe_experts=config.num_experts,
                      moe_top_k=config.router_top_k,

@@ -270,6 +270,8 @@ def test_package_import_honors_platform_env():
     for env_extra, want in (
             ({"JAX_PLATFORMS": "cpu", "JAX_PLATFORM_NAME": "tpu"}, "cpu"),
             ({"JAX_PLATFORMS": "", "JAX_PLATFORM_NAME": "cpu"}, "cpu"),
+            # jax lowercases JAX_PLATFORM_NAME itself; the hook must too
+            ({"JAX_PLATFORMS": "", "JAX_PLATFORM_NAME": "CPU"}, "cpu"),
             # neither set: the forced value must be left alone (no-op)
             ({"JAX_PLATFORMS": "", "JAX_PLATFORM_NAME": ""},
              "bogus_accel,cpu"),

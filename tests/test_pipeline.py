@@ -730,7 +730,10 @@ def test_pipeline_ep_harness():
         engine="sync", model="gpt", dataset="lm_synth", n_devices=8,
         pipeline_parallel=2, expert_parallel=2, num_experts=4,
         microbatches=2, batch_size=4, epochs=1, log_every=0,
-        dataset_fn=lm_fn))
+        dataset_fn=lm_fn,
+        # the overflow warning's advised remediation must be reachable:
+        # moe_capacity_factor is a stage --model-arg on the pp x ep path
+        model_args={"moe_capacity_factor": 2.0}))
     assert summary["engine"] == "pipeline_ep[dp*pp*ep,gpipe]"
     assert np.isfinite(summary["test_loss"])
 
