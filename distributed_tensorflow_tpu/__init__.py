@@ -42,18 +42,64 @@ def _honor_platform_env() -> None:
     point (the sitecustomize preload IS such an update).  An embedding
     application that wants a different platform than its env vars say
     should update ``jax.config`` AFTER importing this package, or unset
-    the env vars."""
+    the env vars.  If that host process already *initialized* a backend
+    before importing us, the re-assert cannot take effect for this
+    process — a RuntimeWarning says so instead of no-opping silently."""
     import os
 
-    want = (os.environ.get("JAX_PLATFORMS")
-            or os.environ.get("JAX_PLATFORM_NAME"))
+    plats = os.environ.get("JAX_PLATFORMS")
+    if plats:
+        # verbatim: jax_platforms entries are case-sensitive lookups
+        # against registered backend/plugin names — lowercasing here would
+        # break a PJRT plugin registered under a non-lowercase name
+        want = plats
+    else:
+        name = os.environ.get("JAX_PLATFORM_NAME")
+        # jax itself lowercases JAX_PLATFORM_NAME (xla_bridge) — match it
+        # so e.g. JAX_PLATFORM_NAME=CPU selects cpu instead of erroring
+        want = name.lower() if name else None
     if want:
         import jax
 
-        # jax itself lowercases JAX_PLATFORM_NAME (xla_bridge) while
-        # jax_platforms lookups are case-sensitive — normalize so e.g.
-        # JAX_PLATFORM_NAME=CPU selects cpu instead of erroring
-        jax.config.update("jax_platforms", want.lower())
+        active: set = set()
+        try:
+            # passive peek at initialized backends: the public
+            # backends() accessor would itself initialize one
+            from jax._src import xla_bridge as _xla_bridge
+
+            active = set(_xla_bridge._backends)
+        except Exception:  # pragma: no cover - jax internals moved
+            pass
+        jax.config.update("jax_platforms", want)
+        wanted: set = set()
+        for token in want.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            try:
+                # aliases name backend sets ('gpu' → cuda/rocm): expand so
+                # a live cuda backend under JAX_PLATFORMS=gpu doesn't warn
+                wanted.update(_xla_bridge.expand_platform_alias(token.lower()))
+            except Exception:
+                pass
+            wanted.add(token)
+        # the WARNING check is case-insensitive on both sides (the config
+        # value itself stays verbatim): a live 'MyPlugin' backend under
+        # JAX_PLATFORMS=MyPlugin is a match, not a conflict
+        wanted_ci = {w.lower() for w in wanted}
+        active_ci = {a.lower() for a in active}
+        if active_ci and not (active_ci & wanted_ci):
+            # a backend is live on a platform the env did NOT ask for: the
+            # config update above cannot take effect for this process
+            import warnings
+
+            warnings.warn(
+                f"distributed_tensorflow_tpu: a JAX backend is already "
+                f"initialized on {sorted(active)}, so re-asserting "
+                f"jax_platforms={want!r} from the environment cannot take "
+                f"effect for this process; import this package (or set "
+                f"jax.config) before touching any jax device API",
+                RuntimeWarning, stacklevel=3)
 
 
 _honor_platform_env()

@@ -201,6 +201,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--router-z-weight", type=float, default=0.0,
                    help="MoE router z-loss weight (0 disables; ~1e-3 "
                         "stabilizes router logits on long runs)")
+    p.add_argument("--steps-per-call", type=int, default=None,
+                   help="steady-state drain: training steps rolled into one "
+                        "jitted lax.scan per host dispatch (README "
+                        "'steady-state performance').  Default auto: 8, "
+                        "downshifting to 1 when a per-step cadence "
+                        "(--metrics-path, --watchdog-timeout, a "
+                        "steps-to-target run) needs the host every step")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="device-prefetch depth: host batches staged onto "
+                        "the mesh this many steps ahead so transfer N+1 "
+                        "overlaps compute N (data/device_prefetch.py)")
     p.add_argument("--result-path", default=None, help="JSONL event sink path")
     p.add_argument("--supervisor", default=None, metavar="HOST[:PORT]",
                    help="report the reference's start/done/results event "
@@ -319,6 +330,8 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         degree=args.degree,
         seed=args.seed,
         log_every=args.log_every,
+        steps_per_call=args.steps_per_call,
+        prefetch=args.prefetch,
         result_path=args.result_path,
         supervisor_address=args.supervisor,
         seq_parallel=args.seq_parallel,

@@ -14,6 +14,10 @@ from __future__ import annotations
 
 from typing import Callable
 
+from distributed_tensorflow_tpu.data.device_prefetch import (  # noqa: F401
+    DevicePrefetch,
+    device_prefetch,
+)
 from distributed_tensorflow_tpu.data.loaders import (
     Dataset,
     load_dataset,

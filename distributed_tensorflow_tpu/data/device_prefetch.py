@@ -1,0 +1,103 @@
+"""Async host→device prefetch over any host batch iterator.
+
+The steady-state half of the input path: ``iter_batches`` / the native C++
+batcher produce host numpy batches, and this wrapper keeps a small buffer of
+batches *already placed on device* with the engine's input ``NamedSharding``
+(via the engine's ``shard_batch``, i.e. a non-blocking ``jax.device_put``),
+so the host→device transfer for batch N+1 overlaps the device compute of
+batch N.  The reference has no counterpart — its input prep, TCP transfer
+and training interleave serially on one Python thread (reference
+initializer.py:24-55, client.py:78-95).
+
+Iterator contract (shared with data.pipeline / native.batcher): the wrapped
+``batches`` iterable yields host batches (any tuple shape — the ``place``
+callable owns the interpretation) and MAY expose ``close()`` (generators do;
+the native batcher's epoch iterator does, to release its busy claim).  The
+prefetcher reads ahead of its consumer, so when the consumer stops early
+(max_steps, early-stop, an exception) it must be ``close()``d — which closes
+the source — rather than abandoned to GC timing.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterable, Iterator
+
+
+class DevicePrefetch:
+    """Iterator of device-placed batches with a bounded read-ahead buffer.
+
+    ``place`` maps one host batch to its device form (typically
+    ``engine.shard_batch``); placement is issued eagerly for up to ``depth``
+    batches beyond the one the consumer holds.  ``jax.device_put`` is
+    asynchronous, so issuing the placement *is* starting the transfer —
+    no thread is needed, the XLA transfer engine does the overlap.
+    """
+
+    def __init__(self, batches: Iterable, place: Callable, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._source: Iterator | None = iter(batches)
+        self._place = place
+        self._depth = depth
+        self._buf: collections.deque = collections.deque()
+        self._fill()
+
+    def _fill(self) -> None:
+        while self._source is not None and len(self._buf) < self._depth:
+            try:
+                host = next(self._source)
+            except StopIteration:
+                self._release_source()
+                break
+            self._buf.append(self._place(host))
+
+    def __iter__(self) -> "DevicePrefetch":
+        return self
+
+    def __next__(self):
+        if not self._buf:
+            self._fill()
+        if not self._buf:
+            raise StopIteration
+        out = self._buf.popleft()
+        # issue the replacement transfer BEFORE handing the batch to the
+        # consumer: the device computes on `out` while this one stages
+        self._fill()
+        return out
+
+    def take(self, n: int) -> list:
+        """Up to ``n`` next batches (fewer at exhaustion, [] when done) —
+        the chunk-assembly call of the Trainer's multi-step drain."""
+        out: list = []
+        while n > 0 and len(out) < n:
+            try:
+                out.append(next(self))
+            except StopIteration:
+                break
+        return out
+
+    def _release_source(self) -> None:
+        src, self._source = self._source, None
+        if src is not None:
+            close = getattr(src, "close", None)
+            if close is not None:
+                close()
+
+    def close(self) -> None:
+        """Drop buffered batches and close the source iterator (releases a
+        native batcher's busy claim; see module docstring)."""
+        self._buf.clear()
+        self._release_source()
+
+    def __del__(self):  # pragma: no cover - GC-timing safety net
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def device_prefetch(batches: Iterable, place: Callable,
+                    depth: int = 2) -> DevicePrefetch:
+    """Wrap a host batch iterator in a :class:`DevicePrefetch`."""
+    return DevicePrefetch(batches, place, depth=depth)

@@ -84,7 +84,11 @@ class Dataset:
         thread needs a core of its own to overlap with the training step;
         measured a wash on 1-core hosts), falling back to the pure-Python
         path; True requires the native path; False forces Python.  Both
-        paths yield byte-identical batches (tests/test_native.py).
+        paths yield byte-identical batches (tests/test_native.py) and honor
+        the shared iterator contract (data/pipeline.py module docstring):
+        same-size (x, y, mask) batches plus ``close()`` for early release —
+        what data.device_prefetch wraps to stage batches on device ahead
+        of the training loop.
         """
         from distributed_tensorflow_tpu.data.pipeline import iter_batches
 

@@ -11,6 +11,15 @@ Batches are yielded as (x, y, mask): ``mask`` flags padding rows added so
 every batch divides evenly over the device mesh — eval stays exact without
 dropping the remainder (the reference's server-side eval also uses the full
 test set, reference server.py:24-37, 179-180).
+
+Iterator contract (shared by this module, native.batcher, and any custom
+producer): one epoch of ``(x, y, mask)`` host-numpy batches, every batch the
+same leading size (padded+masked final batch unless ``drop_remainder``), and
+an optional ``close()`` for early release (plain generators have one; the
+native batcher's epoch iterator uses it to free its busy claim).  Consumers
+that read AHEAD of the training loop — data.device_prefetch, which stages
+batches on device so transfer overlaps compute — rely on exactly this
+surface and must call ``close()`` when stopping early.
 """
 
 from __future__ import annotations

@@ -17,8 +17,13 @@ from typing import Callable
 import jax
 import numpy as np
 
+from distributed_tensorflow_tpu.data.device_prefetch import DevicePrefetch
 from distributed_tensorflow_tpu.engines.sync import SyncEngine
 from distributed_tensorflow_tpu.utils.metrics import StepTimer
+
+# steady-state chunk length when no per-step cadence demands step-granular
+# host control (see Trainer.resolve_steps_per_call)
+DEFAULT_STEPS_PER_CALL = 8
 
 
 class Trainer:
@@ -36,13 +41,53 @@ class Trainer:
         self.state = None
         self.history: list[dict] = []
 
+    @staticmethod
+    def resolve_steps_per_call(steps_per_call: int | None, *,
+                               metrics_logger=None, watchdog=None,
+                               target_accuracy: float | None = None,
+                               checkpoint_every: int = 0) -> int:
+        """Chunk length of the steady-state drain (``fit(steps_per_call=)``).
+
+        An explicit value wins (validated ≥ 1).  Auto (``None``) picks
+        ``DEFAULT_STEPS_PER_CALL`` unless a per-step cadence demands the
+        host between every step, in which case it downshifts to 1:
+
+        * ``metrics_logger`` — the per-step JSONL sink's throttle decides
+          step by step which records to even compute;
+        * ``watchdog`` — stall detection resolution is one beat per host
+          sync, and a chunk would coarsen it k×;
+        * ``target_accuracy`` — the near-target eval cadence (≤10 steps)
+          is the steps-to-target figure's resolution (BASELINE.md).
+
+        Heartbeat logging (``log_every``) does NOT downshift: the scanned
+        drain returns the full per-step metric trajectory each chunk, so
+        log lines stay step-exact.  A ``checkpoint_every`` shorter than
+        the chunk caps auto's k to it (state only exists at chunk
+        boundaries, and silently saving k-coarser than asked would widen
+        the crash-loss window); with an EXPLICIT steps_per_call,
+        checkpoints land on the first chunk boundary at/after their due
+        step instead.
+        """
+        if steps_per_call is not None:
+            if steps_per_call < 1:
+                raise ValueError(
+                    f"steps_per_call must be >= 1, got {steps_per_call}")
+            return int(steps_per_call)
+        if (metrics_logger is not None or watchdog is not None
+                or target_accuracy is not None):
+            return 1
+        if 0 < checkpoint_every < DEFAULT_STEPS_PER_CALL:
+            return checkpoint_every
+        return DEFAULT_STEPS_PER_CALL
+
     def fit(self, train_ds, epochs: int = 1, batch_size: int | None = None,
             log_every: int = 50, log_fn: Callable[[str], None] = print,
             checkpoint_manager=None, checkpoint_every: int = 0,
             metrics_logger=None, watchdog=None, nan_guard: bool = True,
             max_steps: int | None = None, eval_ds=None,
             target_accuracy: float | None = None, eval_every: int = 50,
-            eval_batch: int = 100) -> dict:
+            eval_batch: int = 100, steps_per_call: int | None = None,
+            prefetch: int = 2) -> dict:
         """Train; returns {'elapsed': seconds_around_fit, 'steps': n, ...} —
         the reference's only training metrics (reference dist_keras.py:41-49).
 
@@ -61,11 +106,32 @@ class Trainer:
         figure (BASELINE.md north star) has ≤10-step resolution without
         paying full-eval cost on every step.  The result then carries
         ``reached_target`` and ``eval_accuracy``.
+
+        Steady state: host batches are staged onto the mesh ``prefetch``
+        batches ahead (data/device_prefetch.py — transfer N+1 overlaps
+        compute N), and ``steps_per_call`` > 1 drains chunks of k
+        pre-staged batches through one jitted ``lax.scan`` of the engine's
+        train step (``Engine.build_many_step``), with the per-step
+        loss/accuracy trajectory carried on-device and materialized once
+        per chunk — and, when no chunk-boundary state consumer (periodic
+        checkpoints, target eval) is active, up to ``max_in_flight``
+        dispatched chunks stay unmaterialized so a slow host↔device link
+        is paid per window, not per chunk.  Default auto:
+        ``resolve_steps_per_call`` — 8, unless a per-step cadence
+        (metrics_logger, watchdog, target_accuracy) downshifts to 1 or a
+        shorter ``checkpoint_every`` caps it.  Checkpoint/eval/early-stop/
+        nan-guard semantics hold at chunk boundaries; the chunked
+        trajectory is step-for-step identical to ``steps_per_call=1`` on
+        the same seed.
         """
         from distributed_tensorflow_tpu.utils.failure import check_finite
         if target_accuracy is not None and eval_ds is None:
             raise ValueError("target_accuracy requires eval_ds (nothing "
                              "would ever be evaluated against the target)")
+        if prefetch < 1:
+            # same contract as DevicePrefetch itself: reject, don't clamp
+            # (a silently-promoted --prefetch 0 would misreport its depth)
+            raise ValueError(f"prefetch depth must be >= 1, got {prefetch}")
         eng = self.engine
         bs = batch_size or train_ds.batch_size or 32
         bs = max(bs, eng.n_devices)
@@ -105,6 +171,11 @@ class Trainer:
         # instead of restarting at 1
         # (.reshape(-1)[0]: async engine's step is per-device, one per shard)
         start_step = int(np.asarray(jax.device_get(self.state.step)).reshape(-1)[0])
+        k = self.resolve_steps_per_call(
+            steps_per_call, metrics_logger=metrics_logger, watchdog=watchdog,
+            target_accuracy=target_accuracy,
+            checkpoint_every=(checkpoint_every
+                              if checkpoint_manager is not None else 0))
         timer = StepTimer()
         t0 = time.perf_counter()
         steps = 0
@@ -116,74 +187,193 @@ class Trainer:
         stop = False
         prev_eval_step = 0   # step of the eval BEFORE the current one —
         eval_gap = None      # the honest resolution of a reached target
+
+        def place(batch):
+            # staged with the engine's input NamedSharding; device_put is
+            # non-blocking, so the prefetcher's read-ahead IS the overlap
+            bx, by, _mask = batch
+            return self.engine.shard_batch(bx, by, process_local=n_procs > 1)
+
+        def eval_and_maybe_stop(prev_steps: int, at_cap: bool) -> bool:
+            """Target-accuracy eval at the cadence boundary (shared by both
+            drain shapes); True = target reached, stop now.  Fine cadence
+            when the answer could be near: the first window (fast-saturating
+            tasks cross before a coarse first eval) and once accuracy is
+            within 0.05 of the target; coarse in between.  Always evaluates
+            at the cap so hitting max_steps can't return a stale (or
+            never-computed) accuracy."""
+            nonlocal eval_acc, prev_eval_step, eval_gap, reached, stop
+            if target_accuracy is None or eval_ds is None:
+                return False
+            near = (eval_acc >= target_accuracy - 0.05 or steps <= eval_every)
+            cadence = max(min(eval_every, 10) if near else eval_every, 1)
+            # crossing test, not modulo: chunk boundaries may step past the
+            # due step without landing on it (k == 1 reduces to steps%cadence)
+            if not (steps // cadence > prev_steps // cadence or at_cap):
+                return False
+            gap = steps - prev_eval_step
+            prev_eval_step = steps
+            eval_acc = self.evaluate(eval_ds, batch_size=eval_batch)["accuracy"]
+            if eval_acc >= target_accuracy:
+                # the crossing lies somewhere in the gap since the previous
+                # eval — report THAT as the steps-to-target resolution
+                eval_gap = gap
+                reached = stop = True
+                return True
+            return False
+
+        def record_step(gstep: int, floats_fn) -> None:
+            """Per-step sinks shared by both drain shapes: metrics-logger
+            (log FIRST — a diverging step's NaN record must reach the sink
+            before check_finite raises), then the log_every heartbeat with
+            its nan guard.  ``floats_fn`` materializes the step's float
+            metrics lazily: the k==1 path must not sync the device unless
+            a cadence actually fires (max_in_flight keeps it async)."""
+            nonlocal last_metrics
+            if metrics_logger is not None and metrics_logger.should_log(gstep):
+                floats = floats_fn()
+                metrics_logger.log(gstep, **floats)
+                if nan_guard:
+                    check_finite(floats, gstep)
+            if log_every and steps % log_every == 0:
+                m = floats_fn()
+                if nan_guard:
+                    check_finite(m, gstep)
+                last_metrics = m
+                # progress heartbeat — reference client.py:92-94
+                log_fn(f"step {gstep}  loss {m['loss']:.4f}"
+                       f"  acc {m['accuracy']:.4f}")
+
         for epoch in range(epochs):
             if stop:
                 break
-            for bx, by, _ in train_ds.batches(
-                    local_bs, shuffle=True, seed=self.seed, epoch=epoch,
-                    drop_remainder=True):
-                with timer:  # amortized dispatch+throttle time (see result)
-                    xs, ys = self.engine.shard_batch(
-                        bx, by, process_local=n_procs > 1)
-                    self.state, metrics = eng.step(self.state, xs, ys)
-                    in_flight.append(metrics)
-                    if len(in_flight) > self.max_in_flight:
-                        jax.block_until_ready(in_flight.pop(0))
-                if watchdog is not None:
-                    # beat AFTER dispatch+throttle: the first beat arms the
-                    # clock past the first-step XLA compile, and throttling
-                    # bounds how far this loop runs ahead of the device, so
-                    # a hung collective stops the beats within the window
-                    watchdog.beat()
-                steps += 1
-                gstep = start_step + steps
-                examples += len(bx) * n_procs  # global examples per step
-                if metrics_logger is not None and metrics_logger.should_log(gstep):
-                    # throttle-check BEFORE float(): forcing device values
-                    # every step would sync the host into the pipeline that
-                    # max_in_flight deliberately keeps async
-                    floats = {k: float(v) for k, v in metrics.items()}
-                    # log first: the diverging step's NaN record must reach
-                    # the sink before check_finite raises
-                    metrics_logger.log(gstep, **floats)
-                    if nan_guard:
-                        check_finite(floats, gstep)
-                if checkpoint_manager is not None and checkpoint_every and \
-                        gstep % checkpoint_every == 0:
-                    jax.block_until_ready(self.state)
-                    checkpoint_manager.save(self.state)
-                if log_every and steps % log_every == 0:
-                    m = {k: float(v) for k, v in metrics.items()}
-                    if nan_guard:
-                        check_finite(m, gstep)
-                    last_metrics = m
-                    # progress heartbeat — parity with reference client.py:92-94
-                    log_fn(f"step {gstep}  loss {m['loss']:.4f}  acc {m['accuracy']:.4f}")
-                at_cap = max_steps is not None and steps >= max_steps
-                if target_accuracy is not None and eval_ds is not None:
-                    # fine cadence when the answer could be near: the first
-                    # window (fast-saturating tasks cross before a coarse
-                    # first eval) and once accuracy is within 0.05 of the
-                    # target; coarse in between.  Always evaluate on the
-                    # final step so hitting max_steps can't return a stale
-                    # (or never-computed) accuracy.
-                    near = (eval_acc >= target_accuracy - 0.05
-                            or steps <= eval_every)
-                    cadence = min(eval_every, 10) if near else eval_every
-                    if steps % max(cadence, 1) == 0 or at_cap:
-                        gap = steps - prev_eval_step
-                        prev_eval_step = steps
-                        eval_acc = self.evaluate(
-                            eval_ds, batch_size=eval_batch)["accuracy"]
-                        if eval_acc >= target_accuracy:
-                            # the crossing lies somewhere in the gap since
-                            # the previous eval — report THAT as resolution
-                            eval_gap = gap
-                            reached = stop = True
+            pf = DevicePrefetch(
+                train_ds.batches(local_bs, shuffle=True, seed=self.seed,
+                                 epoch=epoch, drop_remainder=True),
+                place, depth=prefetch)
+            try:
+                if k == 1:
+                    for xs, ys in pf:
+                        with timer:  # amortized dispatch+throttle time
+                            self.state, metrics = eng.step(self.state, xs, ys)
+                            in_flight.append(metrics)
+                            if len(in_flight) > self.max_in_flight:
+                                jax.block_until_ready(in_flight.pop(0))
+                        if watchdog is not None:
+                            # beat AFTER dispatch+throttle: the first beat
+                            # arms the clock past the first-step XLA compile,
+                            # and throttling bounds how far this loop runs
+                            # ahead of the device, so a hung collective stops
+                            # the beats within the window
+                            watchdog.beat()
+                        steps += 1
+                        gstep = start_step + steps
+                        examples += bs  # global examples per step
+                        dev_metrics = metrics
+                        record_step(gstep, lambda: {
+                            kk: float(v) for kk, v in dev_metrics.items()})
+                        if checkpoint_manager is not None and \
+                                checkpoint_every and \
+                                gstep % checkpoint_every == 0:
+                            jax.block_until_ready(self.state)
+                            checkpoint_manager.save(self.state)
+                        at_cap = max_steps is not None and steps >= max_steps
+                        if eval_and_maybe_stop(steps - 1, at_cap):
                             break
-                if at_cap:
-                    stop = True
-                    break
+                        if at_cap:
+                            stop = True
+                            break
+                else:
+                    # chunk-level in-flight window — the chunk rendering of
+                    # the k==1 path's max_in_flight throttle: without
+                    # chunk-boundary STATE consumers (periodic checkpoints,
+                    # target eval — which auto mode downshifts for anyway)
+                    # up to max_in_flight dispatched chunks stay
+                    # unmaterialized, so a slow host↔device link (tunnel
+                    # RTT) is paid once per window, not per chunk, and the
+                    # device always has queued work.  With state consumers,
+                    # window 0: every chunk flushes eagerly at its boundary
+                    # so checkpoint/eval see exactly the boundary state.
+                    window = (self.max_in_flight
+                              if checkpoint_manager is None
+                              and target_accuracy is None else 0)
+                    in_flight_chunks: list = []  # (n_steps, t_disp, stacked)
+                    t_mark = 0.0  # end of the previous flush (timing ref)
+
+                    def flush_chunk():
+                        """Materialize the oldest dispatched chunk — ONE
+                        host sync for its (k,)-stacked per-step trajectory —
+                        and run its per-step bookkeeping."""
+                        nonlocal steps, examples, metrics, last_metrics, \
+                            t_mark
+                        n_chunk, t_disp, stacked = in_flight_chunks.pop(0)
+                        floats = {kk: np.asarray(jax.device_get(v))
+                                  for kk, v in stacked.items()}
+                        now = time.perf_counter()
+                        # per-step wall time as the chunk average over the
+                        # non-overlapped span (the first chunk smears its
+                        # XLA compile over its k entries)
+                        dt = (now - max(t_disp, t_mark)) / n_chunk
+                        t_mark = now
+                        timer.times.extend([dt] * n_chunk)
+                        if watchdog is not None:
+                            # beats are per host sync — chunk resolution
+                            # (auto mode downshifts to k=1 under a watchdog)
+                            watchdog.beat()
+                        for i in range(n_chunk):
+                            steps += 1
+                            gstep = start_step + steps
+                            examples += bs  # global examples per step
+                            m = {kk: float(v[i]) for kk, v in floats.items()}
+                            metrics = m
+                            record_step(gstep, lambda m=m: m)
+
+                    dispatched = steps
+                    next_chunk = pf.take(k if max_steps is None
+                                         else min(k, max_steps - dispatched))
+                    while not stop and next_chunk:
+                        chunk = next_chunk
+                        t_disp = time.perf_counter()
+                        self.state, stacked = eng.many_step(
+                            self.state, [c[0] for c in chunk],
+                            [c[1] for c in chunk])
+                        dispatched += len(chunk)
+                        in_flight_chunks.append((len(chunk), t_disp, stacked))
+                        # assemble chunk N+1 while the device runs chunk N
+                        # (dispatch above is async): host batch prep
+                        # overlaps device compute
+                        nxt = k if max_steps is None else min(
+                            k, max_steps - dispatched)
+                        next_chunk = pf.take(nxt) if nxt > 0 else []
+                        while len(in_flight_chunks) > window:
+                            chunk_start = steps
+                            flush_chunk()
+                            if window:
+                                continue
+                            # eager boundary: state consumers run with
+                            # self.state == the just-flushed boundary state
+                            if checkpoint_manager is not None and \
+                                    checkpoint_every and \
+                                    (start_step + steps) // checkpoint_every \
+                                    > (start_step + chunk_start) // checkpoint_every:
+                                # first chunk boundary at/after the due step
+                                jax.block_until_ready(self.state)
+                                checkpoint_manager.save(self.state)
+                            at_cap = (max_steps is not None
+                                      and steps >= max_steps)
+                            # evaluated at chunk boundaries (auto mode runs
+                            # k=1 under target_accuracy, so boundary == step)
+                            if eval_and_maybe_stop(chunk_start, at_cap):
+                                break
+                    # epoch end (or early stop): drain the window in order
+                    while in_flight_chunks:
+                        flush_chunk()
+                    if max_steps is not None and steps >= max_steps:
+                        stop = True
+            finally:
+                # the prefetcher read ahead of the consumer: release the
+                # source (a native batcher's busy claim) deterministically
+                pf.close()
         if (target_accuracy is not None and eval_ds is not None
                 and not reached and steps and prev_eval_step != steps):
             # loop ended by exhausting epochs (not the cap): still finish
@@ -203,14 +393,20 @@ class Trainer:
             checkpoint_manager.save(self.state)
         result = {
             "elapsed": elapsed, "steps": steps, "epochs": epochs,
+            # resolved drain shape (tests/tools read these back: auto mode
+            # downshifts steps_per_call to 1 under per-step cadences)
+            "steps_per_call": k, "prefetch_depth": prefetch,
             "start_step": start_step, "examples": examples,
             "examples_per_sec": examples / elapsed if elapsed > 0 else 0.0,
             **({"reached_target": reached, "eval_accuracy": eval_acc,
                 "eval_resolution": eval_gap}
                if target_accuracy is not None else {}),
-            # per-step wall times: first_step_s isolates XLA compile; steady
-            # percentiles measure dispatch pace (device-throughput-bound once
-            # the max_in_flight window fills)
+            # per-step wall times.  steps_per_call == 1: first_step_s
+            # isolates XLA compile, steady percentiles measure dispatch
+            # pace (device-throughput-bound once the max_in_flight window
+            # fills).  Chunked drain: entries are per-chunk AVERAGES, so
+            # the first chunk smears its compile over its k entries —
+            # compare step_time only between runs of equal steps_per_call
             "step_time": timer.summary(),
             **{f"final_{k}": v for k, v in last_metrics.items()},
         }

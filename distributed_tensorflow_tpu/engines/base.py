@@ -184,6 +184,7 @@ class Engine:
         self.n_devices = self.mesh.shape[self.axis]
         self._step_fn = None
         self._eval_fn = None
+        self._many_step_fns: dict[int, Callable] = {}  # k → jitted scan drain
         self._init_shardings = None  # set by _init_partitioned_state
 
     # ---------------------------------------------------------------- init
@@ -233,6 +234,62 @@ class Engine:
 
     def _build_step(self):
         raise NotImplementedError
+
+    # ------------------------------------------------------ multi-step drain
+    def build_many_step(self, k: int):
+        """One jitted program that runs ``k`` training steps as a
+        ``lax.scan`` over ``k`` pre-staged device batches.
+
+        Signature: ``many(state, xs_k, ys_k) -> (state, metrics)`` where
+        ``xs_k``/``ys_k`` are length-``k`` tuples of batches already placed
+        with this engine's input sharding (``shard_batch``), and each
+        ``metrics`` leaf comes back stacked ``(k,)`` — the per-step
+        trajectory, materializable with ONE host sync per call.  The tuples
+        are stacked on-device inside the jit (no host-side concat), then the
+        scan slices them back per step, so each slice keeps the batch
+        sharding it was placed with.
+
+        This is the steady-state fast path of ``Trainer.fit``
+        (``steps_per_call``): the per-step Python dispatch + host round-trip
+        that made the single-step loop swing 0.87→1.68× with zero code
+        changes (BASELINE.md methodology) happens once per *chunk* instead
+        of once per step.  The scan body is the engine's own donated
+        ``train_step`` — identical math step for step.
+        """
+        if k < 1:
+            raise ValueError(f"steps_per_call must be >= 1, got {k}")
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        step = self._step_fn
+
+        def many(state, xs_k, ys_k):
+            def body(st, batch):
+                x, y = batch
+                return step(st, x, y)
+
+            return jax.lax.scan(body, state,
+                                (jnp.stack(xs_k), jnp.stack(ys_k)))
+
+        return jax.jit(many, donate_argnums=0)
+
+    def many_step(self, state: TrainState, xs_seq, ys_seq):
+        """Run ``len(xs_seq)`` steps through the cached scanned drain
+        (``build_many_step``); one compiled program per distinct chunk
+        length.  Engines with a host-side per-step overflow watch (the MoE
+        engines' ``overflow_monitor``, fed per step by their ``step()``
+        overrides) get it fed here too, one still-lazy slice per step of
+        the stacked metric — same window cadence as the single-step path."""
+        k = len(xs_seq)
+        fn = self._many_step_fns.get(k)
+        if fn is None:
+            fn = self.build_many_step(k)
+            self._many_step_fns[k] = fn
+        state, metrics = fn(state, tuple(xs_seq), tuple(ys_seq))
+        monitor = getattr(self, "overflow_monitor", None)
+        if monitor is not None and "overflow" in metrics:
+            for i in range(k):
+                monitor.observe(metrics["overflow"][i])
+        return state, metrics
 
     # ---------------------------------------------------------------- eval
     def eval_params(self, state: TrainState) -> PyTree:

@@ -27,7 +27,11 @@ class _EpochIterator:
 
     Releases the claim on exhaustion, close(), or garbage collection — even
     if iteration never started (a plain generator's try/finally would not
-    run for an unstarted generator, leaking the claim forever).
+    run for an unstarted generator, leaking the claim forever).  ``close()``
+    is part of the shared batch-iterator contract (data/pipeline.py):
+    read-ahead consumers like data.device_prefetch call it when their
+    consumer stops early, so the claim is released deterministically
+    instead of at GC time.
     """
 
     def __init__(self, batcher: "NativeBatcher", gen):

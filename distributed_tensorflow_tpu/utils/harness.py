@@ -65,6 +65,13 @@ class ExperimentConfig:
     seed: int = 0
     eval_batch: int = 100           # reference's test batch (server.py:179)
     log_every: int = 50
+    steps_per_call: int | None = None  # steady-state drain chunk: steps per
+                                    # jitted lax.scan dispatch (None = auto —
+                                    # 8, downshifting to 1 under per-step
+                                    # cadences; Trainer.resolve_steps_per_call)
+    prefetch: int = 2               # device-prefetch depth: batches staged
+                                    # on the mesh ahead of the step loop so
+                                    # transfer N+1 overlaps compute N
     result_path: str | None = None
     supervisor_address: str | None = None  # reference's -sa / port-4000 channel
     model_fn: Callable | None = None       # user plug-in override (README.md:12)
@@ -1192,7 +1199,9 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                                   checkpoint_every=config.checkpoint_every,
                                   metrics_logger=metrics_logger,
                                   watchdog=watchdog,
-                                  nan_guard=config.nan_guard)
+                                  nan_guard=config.nan_guard,
+                                  steps_per_call=config.steps_per_call,
+                                  prefetch=config.prefetch)
         finally:
             if watchdog is not None:
                 watchdog.close()
@@ -1226,6 +1235,9 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
             "global_batch": global_batch,
             "epochs": config.epochs,
             "steps": fit["steps"],
+            # resolved steady-state drain shape (auto may downshift to 1)
+            "steps_per_call": fit.get("steps_per_call"),
+            "prefetch_depth": fit.get("prefetch_depth"),
             "elapsed_s": fit["elapsed"],
             "examples_per_sec": fit["examples_per_sec"],
             "examples_per_sec_per_device": fit["examples_per_sec"] / total_devices,
@@ -1386,7 +1398,11 @@ def steps_to_accuracy(
     fit = trainer.fit(
         ex.train_ds, epochs=epochs, batch_size=ex.global_batch, log_every=0,
         max_steps=max_steps, eval_ds=ex.test_ds, target_accuracy=target,
-        eval_every=eval_every, eval_batch=config.eval_batch)
+        eval_every=eval_every, eval_batch=config.eval_batch,
+        # steps_per_call auto-downshifts to 1 under target_accuracy (the
+        # steps-to-target resolution IS the per-step cadence); an explicit
+        # config value still passes through for chunk-boundary eval
+        steps_per_call=config.steps_per_call, prefetch=config.prefetch)
     return {
         "reached": bool(fit["reached_target"]),
         "steps": fit["steps"],
