@@ -284,6 +284,7 @@ def bench_throughput() -> None:
     # row is skipped rather than reporting a rate over zero steps)
     dispatch_steps = min(DISPATCH_STEPS, len(ds.x) // global_batch)
     dispatch_rates = []
+    last_fit = {}
     if dispatch_steps:
         trainer = Trainer(None, engine=eng, seed=0)
         trainer.state = state
@@ -293,6 +294,7 @@ def bench_throughput() -> None:
         for _ in range(REPEATS):
             fit = trainer.fit(ds, **fit_kw)
             dispatch_rates.append(fit["examples"] / fit["elapsed"])
+        last_fit = fit
         state = trainer.state
 
     scan_med, scan_spread = _median_spread(scan_rates)
@@ -347,6 +349,13 @@ def bench_throughput() -> None:
                             if disp_per_chip is not None else None),
         "dispatch_spread": (round(disp_spread, 4)
                             if disp_spread is not None else None),
+        # steady-state per-step wall-time percentiles of the shipped fit
+        # loop (compile chunk excluded — StepTimer.compile_steps) and its
+        # input-starvation counter, from the run's own telemetry: the same
+        # numbers the harness's run_report carries (observability/report)
+        "step_time_p50": (last_fit.get("step_time") or {}).get("steady_p50_s"),
+        "step_time_p95": (last_fit.get("step_time") or {}).get("steady_p95_s"),
+        "prefetch_starvation": last_fit.get("prefetch_starvation"),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "flops_per_example_analytic": int(flops_ex),
         "xla_flops_per_step": xla_flops,
@@ -436,6 +445,25 @@ def bench_stream(steps: int = 100) -> None:
             steps * global_batch / (time.perf_counter() - t0))
     rows["resident"], _ = _median_spread(resident_rates)
 
+    # trainer-path telemetry row: the SHIPPED fit loop (device prefetch +
+    # steps_per_call=8 scanned drain) over the same fresh-batch stream —
+    # its steady-state step-time percentiles (compile chunk excluded) and
+    # prefetch starvation counter are the bench's view of the run_report
+    from distributed_tensorflow_tpu.engines import Trainer
+
+    trainer = Trainer(None, engine=eng, seed=0)
+    trainer.state = state
+    # steady percentiles need steps BEYOND the compile chunk (StepTimer
+    # reports None otherwise) — short smoke runs drop to k=1 so even a
+    # 2-step window has a steady tail
+    k_fit = 8 if steps > 8 else 1
+    fit_kw = dict(epochs=1, batch_size=global_batch, log_every=0,
+                  steps_per_call=k_fit, prefetch=2, max_steps=steps)
+    trainer.fit(ds, **fit_kw)  # warm: compiles the drain
+    trainer_fit = trainer.fit(ds, **fit_kw)
+    state = trainer.state
+    fit_st = trainer_fit.get("step_time", {})
+
     # host-only producer rate: the C++ gather pool vs the numpy gather,
     # device out of the loop entirely (this is where the prefetcher acts;
     # the end-to-end rows above also carry host→device transfer)
@@ -464,6 +492,11 @@ def bench_stream(steps: int = 100) -> None:
         **{f"{k}_examples_per_sec": round(v, 1) for k, v in rows.items()},
         "native_vs_python": (round(rows["native"] / rows["python"], 3)
                              if "native" in rows else None),
+        "step_time_p50": fit_st.get("steady_p50_s"),
+        "step_time_p95": fit_st.get("steady_p95_s"),
+        "prefetch_starvation": trainer_fit.get("prefetch_starvation"),
+        "trainer_examples_per_sec": round(
+            trainer_fit["examples"] / trainer_fit["elapsed"], 1),
         **{f"producer_{k}_rows_per_sec": round(v, 1)
            for k, v in producer.items()},
         "producer_native_vs_python": (

@@ -205,9 +205,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="steady-state drain: training steps rolled into one "
                         "jitted lax.scan per host dispatch (README "
                         "'steady-state performance').  Default auto: 8, "
-                        "downshifting to 1 when a per-step cadence "
-                        "(--metrics-path, --watchdog-timeout, a "
-                        "steps-to-target run) needs the host every step")
+                        "downshifting to 1 only for a steps-to-target run "
+                        "(its ≤10-step eval resolution needs boundary "
+                        "state every step); telemetry (--metrics-path, "
+                        "--trace, --watchdog-timeout) rides the chunked "
+                        "drain without downshifting")
     p.add_argument("--prefetch", type=int, default=2,
                    help="device-prefetch depth: host batches staged onto "
                         "the mesh this many steps ahead so transfer N+1 "
@@ -226,8 +228,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="steps between checkpoints (0: final only)")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint before training")
-    p.add_argument("--metrics-path", default=None,
-                   help="per-step metrics JSONL path")
+    p.add_argument("--metrics-path", "--metrics", default=None,
+                   dest="metrics_path",
+                   help="per-step metrics JSONL path (async crash-durable "
+                        "sink; records ride the multi-step scan drain, so "
+                        "this no longer downshifts --steps-per-call)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="structured trace-span JSONL path: a monotonic-"
+                        "clock timeline of compile/chunk_dispatch/"
+                        "materialize/checkpoint/eval spans plus prefetch "
+                        "gauges, with run/host/process ids (README "
+                        "'Observability'); span names are mirrored into "
+                        "XProf when --profile-dir is also set")
     p.add_argument("--profile-dir", default=None,
                    help="write an XLA profiler trace here (TensorBoard/XProf)")
     p.add_argument("--dtype", default="float32",
@@ -236,8 +248,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(f32 params, bf16 activations on the MXU)")
     p.add_argument("--watchdog-timeout", type=float, default=0.0,
                    help=">0: detect a stalled step loop (no progress for this "
-                        "many seconds) and emit a 'stall' event — the "
-                        "reference deadlocks silently instead")
+                        "many seconds PER STEP) and emit a 'stall' event — "
+                        "the reference deadlocks silently instead.  Under "
+                        "--steps-per-call k the loop beats once per chunk "
+                        "and the stall budget scales to k × this value")
     p.add_argument("--watchdog-abort", action="store_true",
                    help="on stall, exit(75) after reporting so a supervisor "
                         "can relaunch with --resume (a wedged XLA runtime "
@@ -354,6 +368,7 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
         metrics_path=args.metrics_path,
+        trace_path=args.trace,
         profile_dir=args.profile_dir,
         dtype=args.dtype,
         watchdog_timeout=args.watchdog_timeout,

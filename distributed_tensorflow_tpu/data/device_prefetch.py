@@ -21,6 +21,7 @@ the source — rather than abandoned to GC timing.
 from __future__ import annotations
 
 import collections
+import time
 from typing import Callable, Iterable, Iterator
 
 
@@ -32,6 +33,23 @@ class DevicePrefetch:
     batches beyond the one the consumer holds.  ``jax.device_put`` is
     asynchronous, so issuing the placement *is* starting the transfer —
     no thread is needed, the XLA transfer engine does the overlap.
+
+    Telemetry (read by the Trainer at chunk boundaries and rolled into the
+    run report) — two complementary signals with DIFFERENT sensitivities:
+
+    * ``fill_wait_s`` is the load-bearing slow-input signal: the
+      consumer-path seconds spent inside the synchronous refill waiting on
+      host batch production — time a slow input pipeline steals from
+      dispatch regardless of depth.  A healthy run keeps it a small
+      fraction of elapsed.
+    * ``starvation`` counts hand-offs that left ZERO batches staged ahead
+      — the read-ahead margin hit bottom.  Because the refill runs to
+      ``depth`` before every hand-off, this is structurally a
+      depth-sizing signal (``depth == 1`` runs with no margin and counts
+      every hand-off; ``depth >= 2`` counts only source exhaustion), NOT
+      a slow-source detector — that is ``fill_wait_s``'s job.
+    * ``queue_depth`` is the staged-batch gauge — a consumer slower than
+      the source sees it pinned at ``depth``.
     """
 
     def __init__(self, batches: Iterable, place: Callable, depth: int = 2):
@@ -41,9 +59,13 @@ class DevicePrefetch:
         self._place = place
         self._depth = depth
         self._buf: collections.deque = collections.deque()
-        self._fill()
+        self.starvation = 0
+        self.fill_wait_s = 0.0
+        self._fill()  # constructor prefill is not consumer wait time
+        self.fill_wait_s = 0.0
 
     def _fill(self) -> None:
+        t0 = time.perf_counter()
         while self._source is not None and len(self._buf) < self._depth:
             try:
                 host = next(self._source)
@@ -51,6 +73,7 @@ class DevicePrefetch:
                 self._release_source()
                 break
             self._buf.append(self._place(host))
+        self.fill_wait_s += time.perf_counter() - t0
 
     def __iter__(self) -> "DevicePrefetch":
         return self
@@ -61,10 +84,30 @@ class DevicePrefetch:
         if not self._buf:
             raise StopIteration
         out = self._buf.popleft()
+        if not self._buf and self._source is not None:
+            # nothing staged ahead of the batch just handed out: the next
+            # transfer starts cold instead of overlapping compute
+            self.starvation += 1
         # issue the replacement transfer BEFORE handing the batch to the
         # consumer: the device computes on `out` while this one stages
         self._fill()
         return out
+
+    @property
+    def queue_depth(self) -> int:
+        """Batches currently staged on device ahead of the consumer."""
+        return len(self._buf)
+
+    @property
+    def depth(self) -> int:
+        """Configured read-ahead bound (the --prefetch knob)."""
+        return self._depth
+
+    def stats(self) -> dict:
+        """Gauge snapshot for the run report / trace timeline."""
+        return {"depth": self._depth, "queue_depth": len(self._buf),
+                "starvation": self.starvation,
+                "fill_wait_s": self.fill_wait_s}
 
     def take(self, n: int) -> list:
         """Up to ``n`` next batches (fewer at exhaustion, [] when done) —

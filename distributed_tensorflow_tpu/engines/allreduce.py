@@ -50,31 +50,39 @@ class Trainer:
 
         An explicit value wins (validated ≥ 1).  Auto (``None``) picks
         ``DEFAULT_STEPS_PER_CALL`` unless a per-step cadence demands the
-        host between every step, in which case it downshifts to 1:
+        host between every step:
 
-        * ``metrics_logger`` — the per-step JSONL sink's throttle decides
-          step by step which records to even compute;
-        * ``watchdog`` — stall detection resolution is one beat per host
-          sync, and a chunk would coarsen it k×;
-        * ``target_accuracy`` — the near-target eval cadence (≤10 steps)
-          is the steps-to-target figure's resolution (BASELINE.md).
+        * ``target_accuracy`` — downshifts to 1: the near-target eval
+          cadence (≤10 steps) IS the steps-to-target figure's resolution
+          (BASELINE.md), and evals need boundary state every step.
 
-        Heartbeat logging (``log_every``) does NOT downshift: the scanned
-        drain returns the full per-step metric trajectory each chunk, so
-        log lines stay step-exact.  A ``checkpoint_every`` shorter than
-        the chunk caps auto's k to it (state only exists at chunk
-        boundaries, and silently saving k-coarser than asked would widen
-        the crash-loss window); with an EXPLICIT steps_per_call,
-        checkpoints land on the first chunk boundary at/after their due
-        step instead.
+        Telemetry does NOT downshift (the zero-downshift contract,
+        observability/):
+
+        * ``metrics_logger`` — per-step records ride the scan's stacked
+          trajectory and are flushed to the async JSONL sink once per
+          chunk, step-exact and bitwise identical to k=1;
+        * ``watchdog`` — beats once per chunk flush with its stall budget
+          rescaled to ``k × per-step timeout`` (Watchdog.rescale): k×
+          coarser detection resolution, k× fewer host syncs;
+        * heartbeat logging (``log_every``) — the drain returns the full
+          per-step trajectory each chunk, so log lines stay step-exact.
+
+        A ``checkpoint_every`` shorter than the chunk caps auto's k to it
+        (state only exists at chunk boundaries, and silently saving
+        k-coarser than asked would widen the crash-loss window); with an
+        EXPLICIT steps_per_call, checkpoints land on the first chunk
+        boundary at/after their due step instead.  ``metrics_logger`` and
+        ``watchdog`` stay in the signature so call sites document what
+        rides along, but no longer affect the result.
         """
+        del metrics_logger, watchdog  # telemetry rides the chunked drain
         if steps_per_call is not None:
             if steps_per_call < 1:
                 raise ValueError(
                     f"steps_per_call must be >= 1, got {steps_per_call}")
             return int(steps_per_call)
-        if (metrics_logger is not None or watchdog is not None
-                or target_accuracy is not None):
+        if target_accuracy is not None:
             return 1
         if 0 < checkpoint_every < DEFAULT_STEPS_PER_CALL:
             return checkpoint_every
@@ -87,7 +95,7 @@ class Trainer:
             max_steps: int | None = None, eval_ds=None,
             target_accuracy: float | None = None, eval_every: int = 50,
             eval_batch: int = 100, steps_per_call: int | None = None,
-            prefetch: int = 2) -> dict:
+            prefetch: int = 2, tracer=None) -> dict:
         """Train; returns {'elapsed': seconds_around_fit, 'steps': n, ...} —
         the reference's only training metrics (reference dist_keras.py:41-49).
 
@@ -99,6 +107,10 @@ class Trainer:
         window and the watchdog's on_stall callback fires).
         ``nan_guard``: divergence check on metrics already materialized at
         the logging cadence (no extra device syncs; utils/failure.py).
+        ``tracer``: an observability.Tracer — spans ``compile`` /
+        ``chunk_dispatch`` / ``materialize`` / ``checkpoint`` / ``eval``
+        plus prefetch queue-depth gauges at chunk boundaries; defaults to
+        the inert NULL_TRACER.
         ``max_steps``: hard step cap across epochs.  ``target_accuracy``
         (with ``eval_ds``): early-stop when test accuracy reaches the
         target — evaluated every ``eval_every`` steps far from the target
@@ -117,14 +129,18 @@ class Trainer:
         checkpoints, target eval) is active, up to ``max_in_flight``
         dispatched chunks stay unmaterialized so a slow host↔device link
         is paid per window, not per chunk.  Default auto:
-        ``resolve_steps_per_call`` — 8, unless a per-step cadence
-        (metrics_logger, watchdog, target_accuracy) downshifts to 1 or a
-        shorter ``checkpoint_every`` caps it.  Checkpoint/eval/early-stop/
+        ``resolve_steps_per_call`` — 8, unless ``target_accuracy``
+        downshifts to 1 or a shorter ``checkpoint_every`` caps it;
+        telemetry (metrics_logger, watchdog) rides the chunked drain
+        without downshifting.  Checkpoint/eval/early-stop/
         nan-guard semantics hold at chunk boundaries; the chunked
         trajectory is step-for-step identical to ``steps_per_call=1`` on
         the same seed.
         """
+        from distributed_tensorflow_tpu.observability.trace import NULL_TRACER
         from distributed_tensorflow_tpu.utils.failure import check_finite
+        if tracer is None:
+            tracer = NULL_TRACER
         if target_accuracy is not None and eval_ds is None:
             raise ValueError("target_accuracy requires eval_ds (nothing "
                              "would ever be evaluated against the target)")
@@ -176,6 +192,19 @@ class Trainer:
             target_accuracy=target_accuracy,
             checkpoint_every=(checkpoint_every
                               if checkpoint_manager is not None else 0))
+        if watchdog is not None:
+            # one beat per host sync = one beat per chunk: the per-step
+            # stall budget becomes a per-beat budget of k × timeout, so
+            # the watchdog rides the chunked drain instead of forcing k=1
+            watchdog.rescale(k)
+        grad_bytes = eng.grad_collective_bytes(self.state)
+        if grad_bytes:
+            # bytes one gradient allreduce moves per step, from the REAL
+            # param dtypes (the bench_decode accounting) — the collective-
+            # path size every scaling analysis starts from
+            tracer.event("collective_profile",
+                         grad_allreduce_bytes=grad_bytes,
+                         n_devices=eng.n_devices)
         timer = StepTimer()
         t0 = time.perf_counter()
         steps = 0
@@ -185,6 +214,10 @@ class Trainer:
         eval_acc = 0.0
         reached = False
         stop = False
+        compiled = False     # first dispatch carries the XLA compile —
+        chunk_sizes: set[int] = set()  # its span is named 'compile'
+        pf_starvation = 0    # prefetch gauges accumulated across epochs
+        pf_fill_wait = 0.0
         prev_eval_step = 0   # step of the eval BEFORE the current one —
         eval_gap = None      # the honest resolution of a reached target
 
@@ -213,7 +246,9 @@ class Trainer:
                 return False
             gap = steps - prev_eval_step
             prev_eval_step = steps
-            eval_acc = self.evaluate(eval_ds, batch_size=eval_batch)["accuracy"]
+            with tracer.span("eval", step=steps):
+                eval_acc = self.evaluate(
+                    eval_ds, batch_size=eval_batch)["accuracy"]
             if eval_acc >= target_accuracy:
                 # the crossing lies somewhere in the gap since the previous
                 # eval — report THAT as the steps-to-target resolution
@@ -254,8 +289,20 @@ class Trainer:
             try:
                 if k == 1:
                     for xs, ys in pf:
+                        chunk_sizes.add(1)  # per ACTUAL dispatch: a
+                        # zero-batch epoch must not report a chunk shape
                         with timer:  # amortized dispatch+throttle time
-                            self.state, metrics = eng.step(self.state, xs, ys)
+                            if not compiled:
+                                # first dispatch traces+compiles the step
+                                # synchronously — span it under the name
+                                # the run report splits out
+                                with tracer.span("compile", steps=1):
+                                    self.state, metrics = eng.step(
+                                        self.state, xs, ys)
+                                compiled = True
+                            else:
+                                self.state, metrics = eng.step(
+                                    self.state, xs, ys)
                             in_flight.append(metrics)
                             if len(in_flight) > self.max_in_flight:
                                 jax.block_until_ready(in_flight.pop(0))
@@ -275,8 +322,9 @@ class Trainer:
                         if checkpoint_manager is not None and \
                                 checkpoint_every and \
                                 gstep % checkpoint_every == 0:
-                            jax.block_until_ready(self.state)
-                            checkpoint_manager.save(self.state)
+                            with tracer.span("checkpoint", step=gstep):
+                                jax.block_until_ready(self.state)
+                                checkpoint_manager.save(self.state)
                         at_cap = max_steps is not None and steps >= max_steps
                         if eval_and_maybe_stop(steps - 1, at_cap):
                             break
@@ -307,8 +355,13 @@ class Trainer:
                         nonlocal steps, examples, metrics, last_metrics, \
                             t_mark
                         n_chunk, t_disp, stacked = in_flight_chunks.pop(0)
-                        floats = {kk: np.asarray(jax.device_get(v))
-                                  for kk, v in stacked.items()}
+                        with tracer.span("materialize", steps=n_chunk):
+                            floats = {kk: np.asarray(jax.device_get(v))
+                                      for kk, v in stacked.items()}
+                        # chunk boundary: prefetch queue-depth/starvation
+                        # gauges ride the same host sync
+                        tracer.gauge("prefetch_depth", pf.queue_depth,
+                                     starvation=pf.starvation)
                         now = time.perf_counter()
                         # per-step wall time as the chunk average over the
                         # non-overlapped span (the first chunk smears its
@@ -317,8 +370,9 @@ class Trainer:
                         t_mark = now
                         timer.times.extend([dt] * n_chunk)
                         if watchdog is not None:
-                            # beats are per host sync — chunk resolution
-                            # (auto mode downshifts to k=1 under a watchdog)
+                            # flush beat: real device progress confirmed
+                            # (the stall budget is k × per-step timeout —
+                            # Watchdog.rescale above)
                             watchdog.beat()
                         for i in range(n_chunk):
                             steps += 1
@@ -334,9 +388,29 @@ class Trainer:
                     while not stop and next_chunk:
                         chunk = next_chunk
                         t_disp = time.perf_counter()
-                        self.state, stacked = eng.many_step(
-                            self.state, [c[0] for c in chunk],
-                            [c[1] for c in chunk])
+                        span_name = "chunk_dispatch" if compiled \
+                            else "compile"
+                        with tracer.span(span_name, steps=len(chunk)):
+                            self.state, stacked = eng.many_step(
+                                self.state, [c[0] for c in chunk],
+                                [c[1] for c in chunk])
+                        if not compiled:
+                            # the first chunk smears its XLA compile over
+                            # its k per-step time entries — tell the timer
+                            # where steady state starts
+                            timer.compile_steps = len(chunk)
+                            compiled = True
+                        if watchdog is not None:
+                            # beat at dispatch too, not only at flush: the
+                            # first dispatch's synchronous trace+compile is
+                            # behind us here, so this arms the clock BEFORE
+                            # the first flush — a device that hangs inside
+                            # the first window would otherwise never arm an
+                            # arm_on_first_beat watchdog (dispatches are
+                            # bounded by the in-flight window, so a hung
+                            # device still stops the beats within it)
+                            watchdog.beat()
+                        chunk_sizes.add(len(chunk))
                         dispatched += len(chunk)
                         in_flight_chunks.append((len(chunk), t_disp, stacked))
                         # assemble chunk N+1 while the device runs chunk N
@@ -357,8 +431,10 @@ class Trainer:
                                     (start_step + steps) // checkpoint_every \
                                     > (start_step + chunk_start) // checkpoint_every:
                                 # first chunk boundary at/after the due step
-                                jax.block_until_ready(self.state)
-                                checkpoint_manager.save(self.state)
+                                with tracer.span("checkpoint",
+                                                 step=start_step + steps):
+                                    jax.block_until_ready(self.state)
+                                    checkpoint_manager.save(self.state)
                             at_cap = (max_steps is not None
                                       and steps >= max_steps)
                             # evaluated at chunk boundaries (auto mode runs
@@ -372,7 +448,10 @@ class Trainer:
                         stop = True
             finally:
                 # the prefetcher read ahead of the consumer: release the
-                # source (a native batcher's busy claim) deterministically
+                # source (a native batcher's busy claim) deterministically,
+                # folding its gauges into the run totals first
+                pf_starvation += pf.starvation
+                pf_fill_wait += pf.fill_wait_s
                 pf.close()
         if (target_accuracy is not None and eval_ds is not None
                 and not reached and steps and prev_eval_step != steps):
@@ -390,12 +469,25 @@ class Trainer:
             last_metrics = last_metrics or final
         elapsed = time.perf_counter() - t0
         if checkpoint_manager is not None:
-            checkpoint_manager.save(self.state)
+            with tracer.span("checkpoint", step=start_step + steps,
+                             final=True):
+                checkpoint_manager.save(self.state)
         result = {
             "elapsed": elapsed, "steps": steps, "epochs": epochs,
             # resolved drain shape (tests/tools read these back: auto mode
-            # downshifts steps_per_call to 1 under per-step cadences)
+            # downshifts steps_per_call to 1 under target_accuracy)
             "steps_per_call": k, "prefetch_depth": prefetch,
+            # chunk lengths actually dispatched (tail chunks, max_steps
+            # truncation and the auto resolution all show up here)
+            "chunk_sizes": sorted(chunk_sizes),
+            # input-path gauges (run-report fodder): hand-offs with zero
+            # read-ahead left, and seconds blocked on host batch production
+            "prefetch_starvation": pf_starvation,
+            "prefetch_fill_wait_s": pf_fill_wait,
+            **({"grad_allreduce_bytes": grad_bytes} if grad_bytes else {}),
+            **({"watchdog_beats": watchdog.beats,
+                "watchdog_stalls": watchdog.stall_episodes}
+               if watchdog is not None else {}),
             "start_step": start_step, "examples": examples,
             "examples_per_sec": examples / elapsed if elapsed > 0 else 0.0,
             **({"reached_target": reached, "eval_accuracy": eval_acc,

@@ -62,6 +62,14 @@ class AsyncLocalEngine(Engine):
             init_fn,
             out_shardings=meshlib.per_device_sharding(self.mesh))(rng)
 
+    def grad_collective_bytes(self, state: TrainState) -> int:
+        """One parameter-averaging round moves ONE model copy per device,
+        not the n_devices-stacked state the base accounting would count
+        (every leaf here carries a leading device axis) — and it runs
+        every ``sync_every`` steps, not per step; the telemetry event
+        records the per-round payload."""
+        return super().grad_collective_bytes(state) // max(self.n_devices, 1)
+
     def _build_step(self):
         loss_fn = make_loss_fn(self.model.apply)
         tx, axis, sync_every = self.tx, self.axis, self.sync_every

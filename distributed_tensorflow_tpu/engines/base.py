@@ -291,6 +291,26 @@ class Engine:
                 monitor.observe(metrics["overflow"][i])
         return state, metrics
 
+    # ----------------------------------------------------------- telemetry
+    def grad_collective_bytes(self, state: TrainState) -> int:
+        """Bytes one gradient collective round moves (the data-axis
+        allreduce of sync DP), from the REAL param leaf dtypes —
+        gradients share the params' shapes and dtypes, so for the
+        replicated-param engines this is the per-step payload (the same
+        itemsize accounting bench_decode uses for its weight-streaming
+        figure, not an assumed 4 B/param).  Engines whose state layout or
+        collective cadence differs override this (async/gossip stack a
+        leading per-device axis and sync every ``sync_every`` steps).
+        0 when the state carries no param pytree."""
+        params = getattr(state, "params", None)
+        if params is None:
+            return 0
+        try:
+            return int(sum(np.prod(a.shape) * a.dtype.itemsize
+                           for a in jax.tree.leaves(params)))
+        except Exception:  # exotic leaf without shape/dtype
+            return 0
+
     # ---------------------------------------------------------------- eval
     def eval_params(self, state: TrainState) -> PyTree:
         """Parameters to evaluate with (replicated). Subclasses with

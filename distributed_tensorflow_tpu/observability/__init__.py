@@ -1,0 +1,41 @@
+"""Observability: on-device metric trajectories, trace spans, run reports.
+
+The reference's only observability is ``print()`` plus one wall-clock
+window (SURVEY.md §5; logging is actively disabled in dist_keras.py:67-68).
+This package is the opposite pole — telemetry that observes the shipped
+fast path instead of disabling it:
+
+  sink     — AsyncJsonlSink: background writer thread over a bounded
+             queue (drop counter on overflow), line-buffered JSONL so a
+             killed run leaves only whole lines.  The host cost of a
+             record is one queue put.
+  trace    — structured span/event timeline (monotonic clock, run/host/
+             process ids) shared with XProf via
+             ``jax.profiler.TraceAnnotation``, plus cheap in-memory span
+             aggregates for the run report even when no file sink is
+             configured.
+  report   — the end-of-run structured summary: steady-state step-time
+             percentiles split from compile, chunk shapes actually used,
+             watchdog heartbeat/stall counts, prefetch starvation totals,
+             sink drops, and the measured telemetry overhead itself.
+
+Why this lives OUTSIDE the step loop's downshift logic: per-step metric
+records ride the ``lax.scan`` carry of ``Engine.build_many_step`` and are
+materialized once per chunk (one host sync per k steps), so enabling
+``--metrics-path`` or the watchdog no longer forces ``Trainer.fit`` down
+to ``steps_per_call=1`` (see Trainer.resolve_steps_per_call).
+"""
+
+from distributed_tensorflow_tpu.observability.report import build_run_report
+from distributed_tensorflow_tpu.observability.sink import (
+    SCHEMA_VERSION, AsyncJsonlSink)
+from distributed_tensorflow_tpu.observability.trace import (
+    NULL_TRACER, Tracer)
+
+__all__ = [
+    "AsyncJsonlSink",
+    "NULL_TRACER",
+    "SCHEMA_VERSION",
+    "Tracer",
+    "build_run_report",
+]

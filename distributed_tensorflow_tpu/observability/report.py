@@ -1,0 +1,86 @@
+"""End-of-run report: one structured summary of how the run behaved.
+
+This is the layer every later scaling PR reads its numbers from — a single
+dict (emitted as the harness's ``run_report`` event and carried in the
+summary) that answers the operational questions a throughput number alone
+cannot:
+
+* steady-state step time p50/p95 SPLIT from compile (the first chunk
+  smears its XLA compile over its k entries; percentiles over the rest);
+* which chunk shapes the drain actually dispatched (``chunk_sizes`` —
+  auto-resolution, tail chunks and ``max_steps`` truncation all show up
+  here);
+* watchdog heartbeat/stall counts, prefetch starvation totals, metric
+  sink drops — the "did telemetry or input starve the device" trio;
+* the measured cost of the telemetry itself (``telemetry_overhead_s`` /
+  ``_frac``): the "metrics+tracing within 5% of telemetry-off" budget is
+  reported by the run, not assumed.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from distributed_tensorflow_tpu.observability.sink import SCHEMA_VERSION
+
+
+def build_run_report(fit_result: dict[str, Any], *,
+                     watchdog=None, metrics_logger=None, tracer=None,
+                     ) -> dict[str, Any]:
+    """Assemble the run report from the Trainer's fit result and the live
+    telemetry objects.  Every argument except ``fit_result`` is optional —
+    absent subsystems report as None, so readers can distinguish
+    "disabled" from "zero"."""
+    st = fit_result.get("step_time") or {}
+    elapsed = float(fit_result.get("elapsed") or 0.0)
+
+    report: dict[str, Any] = {
+        "schema_version": SCHEMA_VERSION,
+        "steps": fit_result.get("steps"),
+        "elapsed_s": elapsed or None,
+        # resolved drain shape + the chunk lengths actually dispatched
+        "steps_per_call": fit_result.get("steps_per_call"),
+        "chunk_sizes": fit_result.get("chunk_sizes"),
+        "prefetch_depth": fit_result.get("prefetch_depth"),
+        # steady-state percentiles (compile excluded — see StepTimer)
+        "compile_s": st.get("compile_s", st.get("first_step_s")),
+        "step_time_p50_s": st.get("steady_p50_s"),
+        "step_time_p95_s": st.get("steady_p95_s"),
+        "step_time_mean_s": st.get("steady_mean_s"),
+    }
+
+    report["watchdog"] = None if watchdog is None else {
+        "beats": watchdog.beats,
+        "stall_episodes": watchdog.stall_episodes,
+        "timeout_s": watchdog.timeout,
+    }
+
+    starvation = fit_result.get("prefetch_starvation")
+    report["prefetch"] = None if starvation is None else {
+        "depth": fit_result.get("prefetch_depth"),
+        "starvation": starvation,
+        "fill_wait_s": fit_result.get("prefetch_fill_wait_s"),
+    }
+
+    report["metrics_sink"] = None if metrics_logger is None else \
+        metrics_logger.stats()
+
+    overhead = 0.0
+    if tracer is not None and tracer.enabled:
+        report["spans"] = tracer.span_summary()
+        tstats = tracer.stats()
+        report["trace"] = {k: v for k, v in tstats.items()
+                           if k in ("written", "dropped")} or None
+        overhead += tracer.overhead_s
+    else:
+        report["spans"] = None
+        report["trace"] = None
+    if metrics_logger is not None:
+        overhead += getattr(metrics_logger, "overhead_s", 0.0)
+
+    # the telemetry's own measured cost, against the run's wall clock —
+    # this is the number the 5%-overhead acceptance bound reads
+    report["telemetry_overhead_s"] = round(overhead, 6)
+    report["telemetry_overhead_frac"] = (
+        round(overhead / elapsed, 6) if elapsed > 0 else None)
+    return report

@@ -70,10 +70,12 @@ class Watchdog:
                  poll_interval: float | None = None,
                  arm_on_first_beat: bool = True):
         self.timeout = timeout
+        self.base_timeout = timeout   # per-step budget (rescale() reference)
         self.on_stall = on_stall
         self.stalled = False          # live view: currently in a stall?
         self.stall_episodes = 0
         self.stall_elapsed = 0.0      # beat age when the episode fired
+        self.beats = 0                # heartbeat count (run-report gauge)
         # arm_on_first_beat: don't count the window before the first beat —
         # the first training step's blocking XLA compile routinely exceeds
         # any sane stall timeout and would fire a false episode.  Tradeoff:
@@ -89,6 +91,23 @@ class Watchdog:
     def beat(self) -> None:
         with self._lock:
             self._last = time.monotonic()
+            self.beats += 1
+
+    def rescale(self, steps_per_beat: int) -> None:
+        """Adapt the stall budget to a chunked step loop: with
+        ``steps_per_call = k`` the Trainer beats once per CHUNK dispatch
+        and once per chunk flush (the host sync), so the per-step
+        ``base_timeout`` becomes a per-beat budget of ``k × base_timeout``
+        (dispatches are bounded by the in-flight window, so a hung device
+        still stops the beats within it).  This is what lets the
+        watchdog ride the multi-step scan drain instead of forcing
+        ``steps_per_call`` down to 1 — stall detection resolution coarsens
+        k×, which is the honest price of k× fewer host syncs."""
+        if steps_per_beat < 1:
+            raise ValueError(
+                f"steps_per_beat must be >= 1, got {steps_per_beat}")
+        with self._lock:
+            self.timeout = self.base_timeout * steps_per_beat
 
     def _beat_age(self) -> float:
         with self._lock:

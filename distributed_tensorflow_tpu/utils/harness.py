@@ -67,8 +67,9 @@ class ExperimentConfig:
     log_every: int = 50
     steps_per_call: int | None = None  # steady-state drain chunk: steps per
                                     # jitted lax.scan dispatch (None = auto —
-                                    # 8, downshifting to 1 under per-step
-                                    # cadences; Trainer.resolve_steps_per_call)
+                                    # 8, downshifting to 1 only for
+                                    # steps-to-target runs; telemetry rides
+                                    # the chunk — resolve_steps_per_call)
     prefetch: int = 2               # device-prefetch depth: batches staged
                                     # on the mesh ahead of the step loop so
                                     # transfer N+1 overlaps compute N
@@ -112,7 +113,11 @@ class ExperimentConfig:
     checkpoint_dir: str | None = None      # enable TrainState checkpointing
     checkpoint_every: int = 0              # steps between checkpoints (0=end only)
     resume: bool = False                   # restore latest checkpoint first
-    metrics_path: str | None = None        # per-step metrics JSONL
+    metrics_path: str | None = None        # per-step metrics JSONL (async
+                                           # crash-durable sink; rides the
+                                           # chunked drain — no downshift)
+    trace_path: str | None = None          # structured span/event JSONL
+                                           # timeline (observability/trace)
     profile_dir: str | None = None         # XLA profiler trace output
     dtype: str = "float32"                 # model compute dtype; 'bfloat16'
                                            # enables mixed precision (params
@@ -1170,6 +1175,16 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         metrics_logger = MetricsLogger(config.metrics_path,
                                        log_every=max(1, config.log_every))
 
+    # the tracer is always live: file-backed when --trace is set,
+    # aggregate-only otherwise (the run report reads its span table and
+    # measured overhead either way; the aggregate cost is two perf_counter
+    # calls per chunk-level span)
+    from distributed_tensorflow_tpu.observability import (
+        Tracer, build_run_report)
+
+    tracer = Tracer(path=config.trace_path,
+                    process_index=jax.process_index())
+
     from distributed_tensorflow_tpu.utils.metrics import profile
 
     watchdog = None
@@ -1181,7 +1196,13 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
             if config.watchdog_abort:
                 # the step loop is wedged inside the XLA runtime; no Python
                 # exception can reach it — exit so a supervisor relaunches
-                # with --resume (EX_TEMPFAIL)
+                # with --resume (EX_TEMPFAIL).  os._exit skips every
+                # finally block AND kills the async sinks' daemon writer
+                # threads, so drain them here first: the records leading up
+                # to the stall are exactly the ones worth keeping
+                if metrics_logger is not None:
+                    metrics_logger.close()
+                tracer.close()
                 sink.close()
                 os._exit(75)
 
@@ -1191,7 +1212,7 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     sink.start()
     try:  # noqa: the sink (and its supervisor socket) must close on ANY exit
         try:
-            with profile(config.profile_dir):
+            with profile(config.profile_dir, tracer=tracer):
                 fit = trainer.fit(train_ds, epochs=config.epochs,
                                   batch_size=global_batch,
                                   log_every=config.log_every,
@@ -1201,12 +1222,14 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                                   watchdog=watchdog,
                                   nan_guard=config.nan_guard,
                                   steps_per_call=config.steps_per_call,
-                                  prefetch=config.prefetch)
+                                  prefetch=config.prefetch,
+                                  tracer=tracer)
         finally:
             if watchdog is not None:
                 watchdog.close()
         sink.done(fit["elapsed"])
-        ev = trainer.evaluate(test_ds, batch_size=config.eval_batch)
+        with tracer.span("eval", final=True):
+            ev = trainer.evaluate(test_ds, batch_size=config.eval_batch)
         sink.results(ev["accuracy"], loss=ev["loss"])
 
         # the summary's engine label comes from the _setup_* function that
@@ -1257,9 +1280,25 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         if config.sample_tokens:
             summary.update(_sample_from_state(config, ex, trainer.state,
                                               test_ds))
+        # end-of-run report: steady-state percentiles split from compile,
+        # chunk shapes actually used, watchdog/prefetch/sink health, and
+        # the telemetry's own measured overhead (observability/report) —
+        # emitted as its own event AND carried in the summary
+        if metrics_logger is not None:
+            # drain the async sink first: stats() read mid-drain would
+            # report written < records, which reads as silent record loss
+            metrics_logger.flush()
+        report = build_run_report(fit, watchdog=watchdog,
+                                  metrics_logger=metrics_logger,
+                                  tracer=tracer)
+        summary["run_report"] = report
+        sink.emit("run_report", **report)
         sink.emit("summary", **summary)
         return summary
     finally:
+        if metrics_logger is not None:
+            metrics_logger.close()  # drain + flush the async JSONL sink
+        tracer.close()
         sink.close()
 
 

@@ -2,18 +2,22 @@
 
 The reference's observability is print() plus one wall-clock window
 (SURVEY.md §5: server.py:72-119 prints; logging actively disabled in
-dist_keras.py:67-68).  Here: structured per-step metric records, step-time
-percentiles for the benchmark harness, and an XLA profiler hook
-(`jax.profiler.trace`) whose output loads in TensorBoard/XProf.
+dist_keras.py:67-68).  Here: structured per-step metric records behind an
+async crash-durable JSONL sink (observability/sink.py), step-time
+percentiles for the benchmark harness with compile split out, and an XLA
+profiler hook (`jax.profiler.trace`) whose window shares a name with the
+structured span timeline (observability/trace.py).
 """
 
 from __future__ import annotations
 
 import contextlib
-import json
 import time
 from pathlib import Path
 from typing import Any, Iterator
+
+from distributed_tensorflow_tpu.observability.sink import (
+    SCHEMA_VERSION, AsyncJsonlSink)
 
 
 class StepTimer:
@@ -21,10 +25,15 @@ class StepTimer:
 
     The reference times one global window between barriers (reference
     server.py:76-79, 115-119); per-step percentiles additionally separate
-    compile (first step) from steady state."""
+    compile from steady state.  ``compile_steps`` is how many leading
+    entries carry the first-call XLA compile — 1 for a single-step loop,
+    the first chunk's length for the scanned drain (its compile is smeared
+    over its k per-step averages); the Trainer sets it as it dispatches.
+    """
 
     def __init__(self):
         self.times: list[float] = []
+        self.compile_steps = 1
         self._t0: float | None = None
 
     def __enter__(self):
@@ -36,32 +45,62 @@ class StepTimer:
         self._t0 = None
         return False
 
-    def summary(self) -> dict[str, float]:
+    def summary(self) -> dict[str, float | None]:
         if not self.times:
             return {}
         xs = sorted(self.times)
         n = len(xs)
-        pick = lambda q: xs[min(n - 1, int(q * n))]  # noqa: E731
-        steady = xs[1:] if n > 1 else xs  # drop the compile step
+        pick = lambda q, s: s[min(len(s) - 1, int(q * len(s)))]  # noqa: E731
+        c = min(max(self.compile_steps, 1), n)
+        # a run that never left the compile chunk has NO steady state —
+        # the steady_* keys go None rather than silently reporting
+        # compile-smeared entries as steady percentiles (compile_s
+        # already carries those seconds)
+        steady = self.times[c:]
+        steady_sorted = sorted(steady)
         return {
             "steps": n,
             "total_s": sum(self.times),
-            "first_step_s": self.times[0],  # includes XLA compile
-            "steady_mean_s": sum(steady) / len(steady),
-            "p50_s": pick(0.50),
-            "p90_s": pick(0.90),
-            "p99_s": pick(0.99),
+            "first_step_s": self.times[0],   # includes XLA compile
+            "compile_s": sum(self.times[:c]),  # the whole compile-smeared
+                                               # prefix (c = first chunk)
+            "steady_mean_s": (sum(steady) / len(steady)) if steady else None,
+            "steady_p50_s": pick(0.50, steady_sorted) if steady else None,
+            "steady_p95_s": pick(0.95, steady_sorted) if steady else None,
+            "p50_s": pick(0.50, xs),
+            "p90_s": pick(0.90, xs),
+            "p95_s": pick(0.95, xs),
+            "p99_s": pick(0.99, xs),
         }
 
 
 class MetricsLogger:
-    """JSONL per-step metrics sink (compose with utils.supervisor.ResultSink
-    for run-level events)."""
+    """Per-step metrics sink (compose with utils.supervisor.ResultSink for
+    run-level events).
 
-    def __init__(self, path: str | Path | None = None, log_every: int = 1):
+    Records are kept in memory (``records``) and — when ``path`` is given —
+    written as JSONL through an :class:`AsyncJsonlSink`: one bounded-queue
+    put per record on the caller's thread, a background thread doing the
+    line-buffered I/O.  Because emission never blocks, the Trainer keeps
+    its ``steps_per_call`` chunking with a metrics logger attached (no
+    downshift): per-step records ride the scan's stacked trajectory and
+    are logged at chunk flush, step-exact and bitwise identical to k=1
+    (tests/test_steady_state.py).
+
+    Crash durability: every line is complete-or-absent (the sink writes
+    one flushed line per record), every record carries ``schema_version``,
+    and ``close()`` drains and flushes.  ``overhead_s`` accumulates this
+    logger's own host cost for the run report's telemetry budget.
+    """
+
+    def __init__(self, path: str | Path | None = None, log_every: int = 1,
+                 queue_size: int = 8192):
         self.path = Path(path) if path else None
         self.log_every = log_every
         self.records: list[dict] = []
+        self.overhead_s = 0.0
+        self._sink = (AsyncJsonlSink(self.path, maxsize=queue_size)
+                      if self.path else None)
 
     def should_log(self, step: int) -> bool:
         """Single home of the throttle policy — callers that must avoid even
@@ -69,24 +108,66 @@ class MetricsLogger:
         return not self.log_every or step % self.log_every == 0
 
     def log(self, step: int, **metrics: Any) -> None:
+        """Record one step's metrics.  ``time`` is the wall clock AT LOG
+        TIME: under a chunked drain (``steps_per_call=k``) a chunk's k
+        records are logged in one burst at chunk flush, so ``time`` marks
+        the flush, not the step — derive per-step timing from the run
+        report's step_time percentiles (or the trace spans), never from
+        gaps between metric records.  The metric VALUES are step-exact
+        and k-invariant (tests/test_steady_state.py parity)."""
         if not self.should_log(step):
             return
-        rec = {"step": step, "time": time.time(),
+        t0 = time.perf_counter()
+        rec = {"schema_version": SCHEMA_VERSION, "step": step,
+               "time": time.time(),
                **{k: float(v) for k, v in metrics.items()}}
         self.records.append(rec)
-        if self.path:
-            with open(self.path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+        if self._sink is not None:
+            self._sink.write(rec)
+        self.overhead_s += time.perf_counter() - t0
+
+    @property
+    def dropped(self) -> int:
+        """Records the bounded queue had to drop (0 without a file sink)."""
+        return self._sink.dropped if self._sink is not None else 0
+
+    def stats(self) -> dict[str, int]:
+        out = {"records": len(self.records), "dropped": self.dropped}
+        if self._sink is not None:
+            out["written"] = self._sink.written
+        return out
+
+    def flush(self) -> None:
+        if self._sink is not None:
+            self._sink.flush()
+
+    def close(self) -> None:
+        """Drain + flush the async sink (flush-on-close contract)."""
+        if self._sink is not None:
+            self._sink.close()
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 @contextlib.contextmanager
-def profile(trace_dir: str | Path | None) -> Iterator[None]:
+def profile(trace_dir: str | Path | None, tracer=None) -> Iterator[None]:
     """XLA profiler window; view with TensorBoard's profile plugin / XProf.
-    No-op when trace_dir is None."""
+    No-op when trace_dir is None.  ``tracer``, when given, records the
+    window as an ``xprof`` span, so the span timeline and the XProf trace
+    cover the same region under the same name (the tracer's spans inside
+    the window additionally appear in XProf via TraceAnnotation)."""
     if trace_dir is None:
         yield
         return
     import jax
 
-    with jax.profiler.trace(str(trace_dir)):
-        yield
+    from distributed_tensorflow_tpu.observability.trace import NULL_TRACER
+
+    t = tracer if tracer is not None else NULL_TRACER
+    with t.span("xprof", trace_dir=str(trace_dir)):
+        with jax.profiler.trace(str(trace_dir)):
+            yield

@@ -119,9 +119,11 @@ def test_metrics_logger(tmp_path):
     ml = MetricsLogger(path, log_every=2)
     for s in range(1, 7):
         ml.log(s, loss=1.0 / s)
+    ml.close()  # drain the async sink (flush-on-close contract)
     recs = [json.loads(l) for l in path.read_text().splitlines()]
     assert [r["step"] for r in recs] == [2, 4, 6]
     assert recs[0]["loss"] == pytest.approx(0.5)
+    assert all(r["schema_version"] == 1 for r in recs)
 
 
 def test_step_timer():

@@ -3,7 +3,11 @@
 Covers the contracts ISSUE 1 names: prefetch ordering/exhaustion/early
 close, padded-final-batch mask correctness through a scanned drain,
 ``steps_per_call > 1`` bitwise parity with ``steps_per_call = 1`` on a
-fixed seed, and the auto-downshift to 1 under per-step cadences.
+fixed seed, and the auto-downshift to 1 — which since ISSUE 2 applies
+ONLY to ``target_accuracy`` runs: telemetry (metrics sink, watchdog)
+rides the chunked drain with zero downshift, including the k=8 vs k=1
+bitwise parity of the on-disk metrics stream and the watchdog's
+chunk-rescaled stall budget (firing and non-firing).
 
 The shard_map engines need a newer jax than some CI containers carry, so
 the Trainer/Engine machinery is exercised through a minimal pure-jit
@@ -96,6 +100,36 @@ def test_prefetch_exhaustion_closes_source():
     pf = DevicePrefetch(src, lambda b: b, depth=4)  # deeper than the epoch
     assert len(list(pf)) == 2
     assert src.closed
+
+
+def test_prefetch_depth_gauge_slow_consumer():
+    """Satellite: a consumer slower than the source sees the queue-depth
+    gauge pinned at the configured --prefetch depth (the buffer is
+    refilled before every hand-off), and never counts starvation."""
+    pf = DevicePrefetch(iter(_host_batches(8)), lambda b: b, depth=3)
+    assert pf.depth == 3
+    assert pf.queue_depth == 3  # staged eagerly at construction
+    for _ in range(4):  # slow consumer: source always ahead
+        next(pf)
+        assert pf.queue_depth == 3
+    assert pf.starvation == 0
+    stats = pf.stats()
+    assert stats["depth"] == stats["queue_depth"] == 3
+    assert stats["fill_wait_s"] >= 0.0
+
+
+def test_prefetch_starvation_counts_empty_readahead():
+    """depth=1 leaves zero batches staged after every hand-off — each
+    next() is a starvation event (the following transfer cannot overlap
+    compute); at depth=2 the same traffic never starves."""
+    pf1 = DevicePrefetch(iter(_host_batches(6)), lambda b: b, depth=1)
+    for i in range(4):
+        next(pf1)
+    assert pf1.starvation == 4
+    pf2 = DevicePrefetch(iter(_host_batches(6)), lambda b: b, depth=2)
+    for i in range(4):
+        next(pf2)
+    assert pf2.starvation == 0
 
 
 def test_prefetch_take_and_depth_validation():
@@ -258,8 +292,11 @@ def test_steps_per_call_parity_bitwise():
 def test_resolve_steps_per_call():
     resolve = Trainer.resolve_steps_per_call
     assert resolve(None) == DEFAULT_STEPS_PER_CALL
-    assert resolve(None, metrics_logger=object()) == 1
-    assert resolve(None, watchdog=object()) == 1
+    # zero-downshift telemetry: metric records ride the scan's stacked
+    # trajectory and the watchdog rescales its budget to the chunk, so
+    # neither forces the host between every step any more
+    assert resolve(None, metrics_logger=object()) == DEFAULT_STEPS_PER_CALL
+    assert resolve(None, watchdog=object()) == DEFAULT_STEPS_PER_CALL
     assert resolve(None, target_accuracy=0.9) == 1
     # a sub-chunk checkpoint cadence caps auto's k (state only exists at
     # chunk boundaries; the requested crash-loss window is honored)
@@ -283,14 +320,88 @@ def test_fit_auto_chunks_and_reports_shape():
     assert r["step_time"]["steps"] == 10  # per-step times, not per-chunk
 
 
-def test_fit_auto_downshifts_for_metrics_logger():
+def test_fit_auto_keeps_chunking_with_metrics_logger():
+    """A metrics logger no longer downshifts auto mode: records are
+    flushed per chunk from the scan's stacked trajectory, step-exact."""
     eng = JitEngine()
     tr = Trainer(None, engine=eng, seed=0)
     ml = MetricsLogger(None, log_every=1)
     r = tr.fit(_tiny_ds(64), epochs=1, batch_size=16, log_every=0,
                metrics_logger=ml, max_steps=3)
-    assert r["steps_per_call"] == 1
+    assert r["steps_per_call"] == DEFAULT_STEPS_PER_CALL
     assert [rec["step"] for rec in ml.records] == [1, 2, 3]
+
+
+def test_metrics_stream_parity_k8_vs_k1_on_disk(tmp_path):
+    """Acceptance: with a file-backed metrics sink and steps_per_call=8,
+    fit does NOT downshift, and the per-step loss/accuracy records in the
+    JSONL stream are bitwise identical to k=1 on the same seed."""
+    def run(k):
+        eng = JitEngine()
+        tr = Trainer(None, engine=eng, seed=0)
+        path = tmp_path / f"metrics_k{k}.jsonl"
+        ml = MetricsLogger(path, log_every=1)
+        r = tr.fit(_tiny_ds(), epochs=2, batch_size=16, log_every=0,
+                   steps_per_call=k, metrics_logger=ml, max_steps=13)
+        ml.close()
+        lines = [json.loads(line) for line in path.read_text().splitlines()]
+        return r, lines
+
+    r1, recs1 = run(1)
+    r8, recs8 = run(8)
+    assert r8["steps_per_call"] == 8  # no downshift under the sink
+    assert r8["chunk_sizes"] == [5, 8]  # 13 = 8 + 5-step tail
+    traj = lambda recs: [(m["step"], m["loss"], m["accuracy"])  # noqa: E731
+                         for m in recs]
+    assert len(recs8) == 13
+    assert traj(recs1) == traj(recs8)
+    assert all(m["schema_version"] == 1 for m in recs8)
+
+
+def test_watchdog_rides_chunked_drain_without_firing():
+    """Satellite: watchdog_timeout works with steps_per_call=8 — the stall
+    budget rescales to k × per-step budget and chunk-boundary beats keep
+    it fed, so a healthy run never fires."""
+    from distributed_tensorflow_tpu.utils.failure import Watchdog
+
+    eng = JitEngine()
+    tr = Trainer(None, engine=eng, seed=0)
+    stalls = []
+    with Watchdog(timeout=5.0, on_stall=stalls.append,
+                  poll_interval=0.01) as wd:
+        r = tr.fit(_tiny_ds(), epochs=2, batch_size=16, log_every=0,
+                   steps_per_call=8, watchdog=wd, max_steps=13)
+    assert r["steps_per_call"] == 8      # no downshift under the watchdog
+    assert wd.timeout == 40.0            # k × per-step budget
+    assert r["watchdog_beats"] == wd.beats >= 2  # one per chunk flush
+    assert r["watchdog_stalls"] == 0 and not stalls
+
+
+def test_watchdog_fires_on_stalled_chunk():
+    """Satellite: a chunk that exceeds k × per-step budget IS a stall —
+    the on_stall callback fires from the monitor thread mid-chunk."""
+    import time as _time
+
+    from distributed_tensorflow_tpu.utils.failure import Watchdog
+
+    class SlowEngine(JitEngine):
+        def many_step(self, state, xs_seq, ys_seq):
+            state, m = super().many_step(state, xs_seq, ys_seq)
+            jax.block_until_ready(m)
+            _time.sleep(0.6)  # well past the scaled 8 × 0.02 s budget
+            return state, m
+
+    eng = SlowEngine()
+    tr = Trainer(None, engine=eng, seed=0)
+    stalls = []
+    # armed from construction: the stalled chunk is the FIRST dispatch,
+    # before any beat exists to arm on
+    with Watchdog(timeout=0.02, on_stall=stalls.append,
+                  poll_interval=0.01, arm_on_first_beat=False) as wd:
+        tr.fit(_tiny_ds(), epochs=1, batch_size=16, log_every=0,
+               steps_per_call=8, watchdog=wd, max_steps=13)
+        assert abs(wd.timeout - 0.16) < 1e-9
+    assert wd.stall_episodes >= 1 and stalls
 
 
 def test_fit_auto_downshifts_for_target_accuracy():
@@ -404,6 +515,7 @@ def test_mnist_cnn_sync_parity_steps_per_call(mesh8):
     r1, traj1 = run(1)
     r8, traj8 = run(8)
     assert r1["steps"] == r8["steps"] == 12
+    assert r8["steps_per_call"] == 8  # metrics sink never downshifts
     assert traj1 == traj8
 
 
@@ -427,3 +539,11 @@ def test_bench_stream_smoke_emits_json():
     if payload.get("skipped"):
         assert payload["value"] is None
         assert payload["error"]
+    else:
+        # telemetry riders: steady-state step-time percentiles (compile
+        # chunk excluded) and the prefetch starvation counter of the
+        # shipped Trainer.fit path
+        assert payload["step_time_p50"] > 0
+        assert payload["step_time_p95"] >= payload["step_time_p50"]
+        assert payload["prefetch_starvation"] >= 0
+        assert payload["trainer_examples_per_sec"] > 0
