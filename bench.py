@@ -204,7 +204,7 @@ def ensure_backend(metric: str) -> None:
 # default mode: training throughput + MFU
 # ---------------------------------------------------------------------------
 
-def bench_throughput() -> None:
+def bench_throughput(grad_compression: str = "none") -> None:
     import jax
 
     from distributed_tensorflow_tpu.data.loaders import load_dataset
@@ -223,7 +223,7 @@ def bench_throughput() -> None:
     # on v5e.  bf16 mixed precision remains available via --dtype bfloat16
     # and wins on transformer-scale matmuls (see tests/test_models.py).
     model = create_model("cnn", num_classes=ds.num_classes)
-    eng = SyncEngine(model, mesh=mesh)
+    eng = SyncEngine(model, mesh=mesh, grad_compression=grad_compression)
 
     rng = np.random.default_rng(0)
     idx = rng.integers(0, len(ds.x), global_batch)
@@ -356,6 +356,12 @@ def bench_throughput() -> None:
         "step_time_p50": (last_fit.get("step_time") or {}).get("steady_p50_s"),
         "step_time_p95": (last_fit.get("step_time") or {}).get("steady_p95_s"),
         "prefetch_starvation": last_fit.get("prefetch_starvation"),
+        # per-step gradient-collective payload: wire bytes under
+        # --grad-compression vs the raw (uncompressed) figure — the BENCH
+        # trajectory's view of the comm win
+        "grad_bytes_per_step_wire": eng.grad_collective_bytes(state),
+        "grad_bytes_per_step_raw": eng.grad_collective_bytes_raw(state),
+        "grad_compression": eng.grad_codec.name,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "flops_per_example_analytic": int(flops_ex),
         "xla_flops_per_step": xla_flops,
@@ -371,7 +377,7 @@ def bench_throughput() -> None:
 # --stream: input pipeline (fresh host batches per step)
 # ---------------------------------------------------------------------------
 
-def bench_stream(steps: int = 100) -> None:
+def bench_stream(steps: int = 100, grad_compression: str = "none") -> None:
     """Training throughput when every step consumes a FRESH host batch —
     the configuration the C++ prefetcher (native/src/pipeline.cc) exists
     for.  'resident' (one device batch reused, the default bench) bounds the
@@ -390,7 +396,7 @@ def bench_stream(steps: int = 100) -> None:
 
     ds = load_dataset("mnist", split="train")
     model = create_model("cnn", num_classes=ds.num_classes)
-    eng = SyncEngine(model, mesh=mesh)
+    eng = SyncEngine(model, mesh=mesh, grad_compression=grad_compression)
     state = eng.init_state(jax.random.key(0), ds.x[:n])
 
     def run_epoch_stream(native: bool | None, st, max_steps: int):
@@ -495,6 +501,9 @@ def bench_stream(steps: int = 100) -> None:
         "step_time_p50": fit_st.get("steady_p50_s"),
         "step_time_p95": fit_st.get("steady_p95_s"),
         "prefetch_starvation": trainer_fit.get("prefetch_starvation"),
+        "grad_bytes_per_step_wire": eng.grad_collective_bytes(state),
+        "grad_bytes_per_step_raw": eng.grad_collective_bytes_raw(state),
+        "grad_compression": eng.grad_codec.name,
         "trainer_examples_per_sec": round(
             trainer_fit["examples"] / trainer_fit["elapsed"], 1),
         **{f"producer_{k}_rows_per_sec": round(v, 1)
@@ -909,7 +918,21 @@ def main() -> None:
     p.add_argument("--no-probe", action="store_true",
                    help="skip the backend-availability probe (saves ~10s "
                         "when the backend is known-good)")
+    p.add_argument("--grad-compression", default="none",
+                   choices=["none", "bf16", "int8"],
+                   help="gradient-collective codec for the default/--stream "
+                        "training benches (parallel/compression.py); the "
+                        "JSON line reports grad_bytes_per_step wire vs raw "
+                        "either way")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache dir — repeat "
+                        "bench invocations skip the warmup recompiles")
     args = p.parse_args()
+    if args.compile_cache:
+        from distributed_tensorflow_tpu.utils.harness import (
+            enable_compile_cache)
+
+        enable_compile_cache(args.compile_cache)
     mode = ("stream" if args.stream else "attention" if args.attention
             else "lm" if args.lm else "moe" if args.moe
             else "decode" if args.decode else "default")
@@ -918,7 +941,8 @@ def main() -> None:
         ensure_backend(metric)
     try:
         if mode == "stream":
-            bench_stream(steps=max(args.steps, 1))
+            bench_stream(steps=max(args.steps, 1),
+                         grad_compression=args.grad_compression)
         elif mode == "attention":
             bench_attention()
         elif mode == "lm":
@@ -928,7 +952,7 @@ def main() -> None:
         elif mode == "decode":
             bench_decode()
         else:
-            bench_throughput()
+            bench_throughput(grad_compression=args.grad_compression)
     except Exception as e:  # noqa: BLE001 — the artifact must stay parsable
         import traceback
         tb = traceback.format_exc()

@@ -201,6 +201,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--router-z-weight", type=float, default=0.0,
                    help="MoE router z-loss weight (0 disables; ~1e-3 "
                         "stabilizes router logits on long runs)")
+    p.add_argument("--grad-compression", default="none",
+                   choices=["none", "bf16", "int8"],
+                   help="compress the cross-device gradient/parameter "
+                        "exchange (parallel/compression.py): bf16 halves "
+                        "the collective wire bytes (the exchange runs in "
+                        "bf16, widened to f32 after), int8 quarters them "
+                        "(per-leaf scale + "
+                        "stochastic rounding, f32 master params kept); "
+                        "none is bitwise identical to the uncompressed "
+                        "path.  Data-parallel and GSPMD engines; the "
+                        "pipeline schedules reject it")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "(jax_compilation_cache_dir): repeat runs and "
+                        "bench warmups skip recompiles of unchanged "
+                        "programs")
     p.add_argument("--steps-per-call", type=int, default=None,
                    help="steady-state drain: training steps rolled into one "
                         "jitted lax.scan per host dispatch (README "
@@ -338,6 +354,8 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         lr_schedule=args.lr_schedule,
         warmup_steps=args.warmup_steps,
         grad_accum=args.grad_accum,
+        grad_compression=args.grad_compression,
+        compile_cache=args.compile_cache,
         weight_decay=args.weight_decay,
         clip_norm=args.clip_norm,
         sync_every=args.sync_every,

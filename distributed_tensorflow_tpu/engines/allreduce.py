@@ -42,6 +42,29 @@ class Trainer:
         self.history: list[dict] = []
 
     @staticmethod
+    def resolve_steps_per_call_with_reason(
+            steps_per_call: int | None, *,
+            metrics_logger=None, watchdog=None,
+            target_accuracy: float | None = None,
+            checkpoint_every: int = 0) -> tuple[int, str | None]:
+        """(k, clamp_reason) — ``resolve_steps_per_call`` plus WHY auto
+        mode downshifted ('target_accuracy' | 'checkpoint_every' | None).
+        The reason comes from the SAME branch that picked k, so the run
+        report's clamp attribution cannot desync from the resolution
+        rules."""
+        del metrics_logger, watchdog  # telemetry rides the chunked drain
+        if steps_per_call is not None:
+            if steps_per_call < 1:
+                raise ValueError(
+                    f"steps_per_call must be >= 1, got {steps_per_call}")
+            return int(steps_per_call), None
+        if target_accuracy is not None:
+            return 1, "target_accuracy"
+        if 0 < checkpoint_every < DEFAULT_STEPS_PER_CALL:
+            return checkpoint_every, "checkpoint_every"
+        return DEFAULT_STEPS_PER_CALL, None
+
+    @staticmethod
     def resolve_steps_per_call(steps_per_call: int | None, *,
                                metrics_logger=None, watchdog=None,
                                target_accuracy: float | None = None,
@@ -77,16 +100,9 @@ class Trainer:
         rides along, but no longer affect the result.
         """
         del metrics_logger, watchdog  # telemetry rides the chunked drain
-        if steps_per_call is not None:
-            if steps_per_call < 1:
-                raise ValueError(
-                    f"steps_per_call must be >= 1, got {steps_per_call}")
-            return int(steps_per_call)
-        if target_accuracy is not None:
-            return 1
-        if 0 < checkpoint_every < DEFAULT_STEPS_PER_CALL:
-            return checkpoint_every
-        return DEFAULT_STEPS_PER_CALL
+        return Trainer.resolve_steps_per_call_with_reason(
+            steps_per_call, target_accuracy=target_accuracy,
+            checkpoint_every=checkpoint_every)[0]
 
     def fit(self, train_ds, epochs: int = 1, batch_size: int | None = None,
             log_every: int = 50, log_fn: Callable[[str], None] = print,
@@ -187,23 +203,49 @@ class Trainer:
         # instead of restarting at 1
         # (.reshape(-1)[0]: async engine's step is per-device, one per shard)
         start_step = int(np.asarray(jax.device_get(self.state.step)).reshape(-1)[0])
-        k = self.resolve_steps_per_call(
+        k, clamp_reason = self.resolve_steps_per_call_with_reason(
             steps_per_call, metrics_logger=metrics_logger, watchdog=watchdog,
             target_accuracy=target_accuracy,
             checkpoint_every=(checkpoint_every
                               if checkpoint_manager is not None else 0))
+        # surface auto-mode downshifts (the run report carries the reason,
+        # attributed by the resolver itself; checkpoint clamps additionally
+        # warn — an explicit steps_per_call is never clamped, checkpoints
+        # then land on chunk boundaries)
+        spc_clamp = None
+        if clamp_reason is not None:
+            spc_clamp = {"requested": DEFAULT_STEPS_PER_CALL,
+                         "effective": k, "reason": clamp_reason}
+            if clamp_reason == "checkpoint_every":
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint_every={checkpoint_every} caps the "
+                    f"steady-state drain at steps_per_call={k} (auto "
+                    f"default {DEFAULT_STEPS_PER_CALL}): state exists only "
+                    f"at chunk boundaries, so the requested crash-loss "
+                    f"window shortens the chunk.  Pass an explicit "
+                    f"--steps-per-call to keep longer chunks (checkpoints "
+                    f"then land on the first boundary at/after each due "
+                    f"step).", stacklevel=2)
         if watchdog is not None:
             # one beat per host sync = one beat per chunk: the per-step
             # stall budget becomes a per-beat budget of k × timeout, so
             # the watchdog rides the chunked drain instead of forcing k=1
             watchdog.rescale(k)
-        grad_bytes = eng.grad_collective_bytes(self.state)
+        grad_bytes = eng.grad_collective_bytes(self.state)        # wire
+        grad_bytes_raw = eng.grad_collective_bytes_raw(self.state)
+        grad_codec = getattr(getattr(eng, "grad_codec", None), "name", "none")
         if grad_bytes:
-            # bytes one gradient allreduce moves per step, from the REAL
-            # param dtypes (the bench_decode accounting) — the collective-
-            # path size every scaling analysis starts from
+            # WIRE bytes one gradient collective moves per round under the
+            # engine's --grad-compression codec, plus the raw (f32-era)
+            # figure for comparison — the collective-path size every
+            # scaling analysis starts from (param dtypes are real, the
+            # bench_decode accounting)
             tracer.event("collective_profile",
                          grad_allreduce_bytes=grad_bytes,
+                         grad_allreduce_bytes_raw=grad_bytes_raw,
+                         grad_compression=grad_codec,
                          n_devices=eng.n_devices)
         timer = StepTimer()
         t0 = time.perf_counter()
@@ -484,7 +526,10 @@ class Trainer:
             # read-ahead left, and seconds blocked on host batch production
             "prefetch_starvation": pf_starvation,
             "prefetch_fill_wait_s": pf_fill_wait,
-            **({"grad_allreduce_bytes": grad_bytes} if grad_bytes else {}),
+            **({"grad_allreduce_bytes": grad_bytes,
+                "grad_allreduce_bytes_raw": grad_bytes_raw,
+                "grad_compression": grad_codec} if grad_bytes else {}),
+            **({"steps_per_call_clamp": spc_clamp} if spc_clamp else {}),
             **({"watchdog_beats": watchdog.beats,
                 "watchdog_stalls": watchdog.stall_episodes}
                if watchdog is not None else {}),

@@ -34,6 +34,7 @@ from jax.sharding import PartitionSpec as P
 
 from distributed_tensorflow_tpu.engines.base import Engine, TrainState, make_loss_fn
 from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel import compression
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
 
@@ -62,21 +63,42 @@ class AsyncLocalEngine(Engine):
             init_fn,
             out_shardings=meshlib.per_device_sharding(self.mesh))(rng)
 
-    def grad_collective_bytes(self, state: TrainState) -> int:
+    def grad_collective_bytes_raw(self, state: TrainState) -> int:
         """One parameter-averaging round moves ONE model copy per device,
         not the n_devices-stacked state the base accounting would count
         (every leaf here carries a leading device axis) — and it runs
         every ``sync_every`` steps, not per step; the telemetry event
         records the per-round payload."""
-        return super().grad_collective_bytes(state) // max(self.n_devices, 1)
+        return (super().grad_collective_bytes_raw(state)
+                // max(self.n_devices, 1))
+
+    def grad_collective_bytes(self, state: TrainState) -> int:
+        """Wire bytes of one parameter-averaging round under the codec,
+        computed on a DE-STACKED abstract copy of the params — the codec
+        accounting must see the exchanged one-copy-per-device shapes
+        (dividing the stacked total by n would shrink int8's per-leaf
+        4-byte scale overhead to 4/n)."""
+        params = getattr(state, "params", None)
+        if params is None:
+            return 0
+        try:
+            one_copy = jax.eval_shape(
+                lambda p: jax.tree.map(lambda a: a[0], p), params)
+            return self.grad_codec.wire_bytes(jax.tree.leaves(one_copy))
+        except Exception:  # exotic leaf without shape/dtype
+            return 0
 
     def _build_step(self):
         loss_fn = make_loss_fn(self.model.apply)
         tx, axis, sync_every = self.tx, self.axis, self.sync_every
+        codec = self.grad_codec
 
         def device_step(state_1: TrainState, x, y):
             s = jax.tree.map(lambda a: a[0], state_1)  # strip size-1 device axis
             rng = self._per_device_rng(s.rng, s.step)
+            # per-device rounding key for the codec: each device quantizes
+            # its OWN parameter copy before the exchange
+            codec_key = compression.codec_rng(rng)
             (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 s.params, x, y, rng)
             # local apply — the analogue of one lock-serialized async update
@@ -84,11 +106,14 @@ class AsyncLocalEngine(Engine):
             params = optax.apply_updates(s.params, updates)
             step = s.step + 1
             do_sync = (step % sync_every) == 0
-            # periodic parameter averaging (the "weight exchange"); predicate
-            # is device-invariant so all devices enter the collective together
+            # periodic parameter averaging (the "weight exchange") through
+            # the compression codec — local SGD's sync payload is the
+            # PARAMETER copy, so that is what gets the reduced-precision
+            # wire treatment ('none' is the plain pmean); predicate is
+            # device-invariant so all devices enter the collective together
             params = jax.lax.cond(
                 do_sync,
-                lambda p: coll.all_reduce_mean(p, axis),
+                lambda p: codec.all_reduce_mean(p, axis, rng=codec_key),
                 lambda p: p,
                 params,
             )

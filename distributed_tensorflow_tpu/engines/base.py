@@ -20,6 +20,7 @@ from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel import compression
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
 PyTree = Any
@@ -177,11 +178,15 @@ class Engine:
         optimizer: optax.GradientTransformation | None = None,
         mesh=None,
         learning_rate: float = 1e-3,
+        grad_compression: str | compression.GradCodec = "none",
     ):
         self.model = model
         self.tx = optimizer if optimizer is not None else optax.adam(learning_rate)
         self.mesh = mesh if mesh is not None else meshlib.create_mesh()
         self.n_devices = self.mesh.shape[self.axis]
+        # cross-device gradient/parameter exchange codec (--grad-compression;
+        # parallel/compression.py): 'none' compiles to the pre-codec program
+        self.grad_codec = compression.make_codec(grad_compression)
         self._step_fn = None
         self._eval_fn = None
         self._many_step_fns: dict[int, Callable] = {}  # k → jitted scan drain
@@ -292,9 +297,9 @@ class Engine:
         return state, metrics
 
     # ----------------------------------------------------------- telemetry
-    def grad_collective_bytes(self, state: TrainState) -> int:
-        """Bytes one gradient collective round moves (the data-axis
-        allreduce of sync DP), from the REAL param leaf dtypes —
+    def grad_collective_bytes_raw(self, state: TrainState) -> int:
+        """UNCOMPRESSED bytes one gradient collective round moves (the
+        data-axis allreduce of sync DP), from the REAL param leaf dtypes —
         gradients share the params' shapes and dtypes, so for the
         replicated-param engines this is the per-step payload (the same
         itemsize accounting bench_decode uses for its weight-streaming
@@ -308,6 +313,26 @@ class Engine:
         try:
             return int(sum(np.prod(a.shape) * a.dtype.itemsize
                            for a in jax.tree.leaves(params)))
+        except Exception:  # exotic leaf without shape/dtype
+            return 0
+
+    def grad_collective_bytes(self, state: TrainState) -> int:
+        """Wire bytes of one gradient collective round under this engine's
+        ``grad_compression`` codec (bf16 halves the raw figure, int8
+        quarters it plus one f32 scale per leaf; 'none' equals
+        ``grad_collective_bytes_raw``).  On the explicit-collective
+        engines (sync/async/gossip) this is what actually crosses ICI;
+        on the GSPMD engines the collective is compiler-inserted and the
+        codec is a quantize→dequantize roundtrip, so this is the codec's
+        payload ACCOUNTING, not the executed transfer
+        (parallel/compression.py module docstring).  Telemetry (the
+        tracer's ``collective_profile`` event, the fit result, bench.py)
+        reports BOTH figures so the compression win is visible."""
+        params = getattr(state, "params", None)
+        if params is None:
+            return 0
+        try:
+            return self.grad_codec.wire_bytes(jax.tree.leaves(params))
         except Exception:  # exotic leaf without shape/dtype
             return 0
 

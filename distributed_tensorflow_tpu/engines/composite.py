@@ -35,6 +35,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distributed_tensorflow_tpu.engines.base import (
     Engine, TrainState, cross_entropy, cross_entropy_onehot, token_weights)
 from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel import compression
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
 
@@ -60,7 +61,8 @@ class CompositeEngine(Engine):
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
                  aux_weight: float = 0.01, router_z_weight: float = 0.0,
                  overflow_warn_threshold: float = 0.25,
-                 overflow_window: int = 50, grad_accum: int = 1):
+                 overflow_window: int = 50, grad_accum: int = 1,
+                 grad_compression: str = "none"):
         from distributed_tensorflow_tpu.engines.expert_parallel import (
             _OverflowMonitor)
 
@@ -97,7 +99,8 @@ class CompositeEngine(Engine):
         self.router_z_weight = router_z_weight
         self.overflow_monitor = _OverflowMonitor(overflow_warn_threshold,
                                                  overflow_window)
-        super().__init__(model, optimizer, mesh, learning_rate)
+        super().__init__(model, optimizer, mesh, learning_rate,
+                         grad_compression=grad_compression)
         self.seq_n = mesh.shape.get(meshlib.SEQ_AXIS, 1)
         self.tp_n = mesh.shape.get(meshlib.MODEL_AXIS, 1)
         impl = getattr(model, "attention_impl", "dense")
@@ -256,8 +259,16 @@ class CompositeEngine(Engine):
             return (jax.tree.map(lambda t: t / K, g_sum),
                     jax.tree.map(lambda t: t / K, a_sum))
 
+        codec = self.grad_codec
+
         def train_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
+            # the codec's rounding key must be derived BEFORE the per-seq-
+            # device fold below: the combined gradient is seq-INVARIANT
+            # (params enter the shard_map at P()), so a per-device key
+            # would quantize each seq replica differently and silently
+            # diverge the parameter copies
+            codec_key = compression.codec_rng(rng)
             if manual:
                 # per-seq-device dropout masks: activations are token blocks,
                 # a shared mask would drop the same local offsets everywhere
@@ -273,6 +284,12 @@ class CompositeEngine(Engine):
                 # pure-GSPMD path: the shared accumulator (aux pytree)
                 grads, _, (loss, acc, total, overflow) = gspmd_grad_accum(
                     grad_fn, state.params, x, y, rng, K, mesh=self.mesh)
+            if codec.name != "none":
+                # the data-axis gradient reduce is GSPMD-inserted (and the
+                # seq-axis contribution arrives via the AD-transpose psum),
+                # so the codec applies as a quantize→dequantize roundtrip
+                # with a seq-invariant key (see codec_key above)
+                grads = codec.roundtrip(grads, rng=codec_key)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             if manual and lm:  # per-seq-block values → report global means

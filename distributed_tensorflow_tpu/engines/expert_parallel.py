@@ -31,6 +31,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distributed_tensorflow_tpu.engines.base import (
     Engine, TrainState, cross_entropy)
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
+from distributed_tensorflow_tpu.parallel import compression
 
 
 class _OverflowMonitor:
@@ -127,7 +128,8 @@ class ExpertParallelEngine(Engine):
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
                  aux_weight: float = 0.01, router_z_weight: float = 0.0,
                  overflow_warn_threshold: float = 0.25,
-                 overflow_window: int = 50, grad_accum: int = 1):
+                 overflow_window: int = 50, grad_accum: int = 1,
+                 grad_compression: str = "none"):
         # (data, expert) base mesh; an optional 'model' axis composes ep×tp
         # — each expert's FFN Megatron-split over it (models/moe.py
         # partition_model), still one GSPMD jit
@@ -144,7 +146,8 @@ class ExpertParallelEngine(Engine):
         self.grad_accum = grad_accum
         self.overflow_monitor = _OverflowMonitor(overflow_warn_threshold,
                                                  overflow_window)
-        super().__init__(model, optimizer, mesh, learning_rate)
+        super().__init__(model, optimizer, mesh, learning_rate,
+                         grad_compression=grad_compression)
         # tokens shard over the WHOLE mesh (see shard_batch), so batch
         # divisibility is against every device, not just the data axis
         self.n_devices = (mesh.shape[meshlib.DATA_AXIS]
@@ -192,6 +195,7 @@ class ExpertParallelEngine(Engine):
                     (task, acc, overflow))
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        codec = self.grad_codec
 
         def train_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
@@ -206,6 +210,12 @@ class ExpertParallelEngine(Engine):
                 grads, loss, (task, acc, overflow) = gspmd_grad_accum(
                     grad_fn, state.params, x, y, rng, K, mesh=self.mesh,
                     batch_axes=(meshlib.DATA_AXIS, meshlib.EXPERT_AXIS))
+            if codec.name != "none":
+                # GSPMD owns the data-axis gradient all-reduce — the codec
+                # applies as a quantize→dequantize roundtrip (compressed-
+                # exchange numerics; parallel/compression.py)
+                grads = codec.roundtrip(
+                    grads, rng=compression.codec_rng(rng))
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             return state.replace(step=state.step + 1, params=params,

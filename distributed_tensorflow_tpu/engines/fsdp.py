@@ -32,6 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distributed_tensorflow_tpu.engines.base import (
     Engine, TrainState, gspmd_value_and_grad, make_loss_fn)
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
+from distributed_tensorflow_tpu.parallel import compression
 
 
 def fsdp_spec(shape: tuple[int, ...], n: int,
@@ -83,7 +84,7 @@ class FSDPEngine(Engine):
     """
 
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
-                 grad_accum: int = 1):
+                 grad_accum: int = 1, grad_compression: str = "none"):
         if mesh is not None:
             extra = set(mesh.axis_names) - {meshlib.DATA_AXIS,
                                             meshlib.MODEL_AXIS}
@@ -93,7 +94,8 @@ class FSDPEngine(Engine):
                     f"mesh, got axes {mesh.axis_names}")
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
-        super().__init__(model, optimizer, mesh, learning_rate)
+        super().__init__(model, optimizer, mesh, learning_rate,
+                         grad_compression=grad_compression)
         self.grad_accum = grad_accum
         self.tp_n = self.mesh.shape.get(meshlib.MODEL_AXIS, 1)
         self._state_shardings = None
@@ -119,6 +121,7 @@ class FSDPEngine(Engine):
     def _build_step(self):
         loss_fn = make_loss_fn(self.model.apply)
         tx, K = self.tx, self.grad_accum
+        codec = self.grad_codec
 
         def train_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
@@ -128,6 +131,14 @@ class FSDPEngine(Engine):
             # optimizer update below then runs fully sharded (ZeRO).
             grads, loss, acc = gspmd_value_and_grad(
                 loss_fn, state.params, x, y, rng, K, mesh=self.mesh)
+            if codec.name != "none":
+                # GSPMD owns the reduce-scatter, so the codec applies as a
+                # quantize→dequantize on the gradient (the numerics of a
+                # compressed exchange; parallel/compression.py module
+                # docstring) — 'none' skips the gate entirely, keeping the
+                # default program bitwise identical
+                grads = codec.roundtrip(
+                    grads, rng=compression.codec_rng(rng))
             updates, opt_state = tx.update(grads, state.opt_state,
                                            state.params)
             params = optax.apply_updates(state.params, updates)

@@ -20,6 +20,7 @@ from jax.sharding import PartitionSpec as P
 from distributed_tensorflow_tpu.engines.async_local import AsyncLocalEngine
 from distributed_tensorflow_tpu.engines.base import TrainState, make_loss_fn
 from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel import compression
 
 
 class GossipEngine(AsyncLocalEngine):
@@ -33,19 +34,27 @@ class GossipEngine(AsyncLocalEngine):
         loss_fn = make_loss_fn(self.model.apply)
         tx, axis = self.tx, self.axis
         degree, mix_every = self.degree, self.mix_every
+        codec = self.grad_codec
 
         def device_step(state_1: TrainState, x, y):
             s = jax.tree.map(lambda a: a[0], state_1)
             rng = self._per_device_rng(s.rng, s.step)
+            # per-device rounding key: each device quantizes its own copy
+            # once, neighbors receive the compressed rendering
+            codec_key = compression.codec_rng(rng)
             (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 s.params, x, y, rng)
             updates, opt_state = tx.update(grads, s.opt_state, s.params)
             params = optax.apply_updates(s.params, updates)
             step = s.step + 1
             do_mix = (step % mix_every) == 0
+            # the gossip mix through the compression codec: the ppermute
+            # hops carry the codec's wire dtype ('none' is the plain
+            # neighbor_mean)
             params = jax.lax.cond(
                 do_mix,
-                lambda p: coll.neighbor_mean(p, axis, degree),
+                lambda p: codec.neighbor_mean(p, axis, degree,
+                                              rng=codec_key),
                 lambda p: p,
                 params,
             )

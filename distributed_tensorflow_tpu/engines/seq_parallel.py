@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distributed_tensorflow_tpu.engines.base import (
     Engine, TrainState, cross_entropy, token_weights)
 from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel import compression
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
 
@@ -43,7 +44,7 @@ class SeqParallelEngine(Engine):
     seq_axis = meshlib.SEQ_AXIS
 
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
-                 grad_accum: int = 1):
+                 grad_accum: int = 1, grad_compression: str = "none"):
         if mesh is None:
             raise ValueError("SeqParallelEngine requires an explicit "
                              "('data','seq') mesh")
@@ -59,7 +60,8 @@ class SeqParallelEngine(Engine):
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self.grad_accum = grad_accum
-        super().__init__(model, optimizer, mesh, learning_rate)
+        super().__init__(model, optimizer, mesh, learning_rate,
+                         grad_compression=grad_compression)
         self.seq_n = mesh.shape[self.seq_axis]
         # causal LMs (models/gpt.py) have (B, L) per-token labels that shard
         # over (data, seq) WITH the inputs, and per-device logits that VARY
@@ -103,9 +105,15 @@ class SeqParallelEngine(Engine):
         tx, K = self.tx, self.grad_accum
         data_axis, seq_axis = self.axis, self.seq_axis
         lm = self.lm
+        codec = self.grad_codec
 
         def device_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
+            # codec rounding key derived BEFORE the per-device folds: the
+            # combined gradient is invariant over BOTH axes (the AD
+            # transpose psums it global), so every device must quantize it
+            # identically or the replicated params silently diverge
+            codec_key = compression.codec_rng(rng)
             rng = jax.random.fold_in(rng, coll.axis_index(data_axis))
             # fold over seq too: every dropout op in the model acts on
             # seq-sharded activations (token blocks), so per-seq-device masks
@@ -179,6 +187,12 @@ class SeqParallelEngine(Engine):
                 (g_sum, l_sum, a_sum, _), _ = lax.scan(micro, init, (xm, ym))
                 grads = jax.tree.map(lambda t: t / K, g_sum)
                 loss, acc = l_sum / K, a_sum / K
+            if codec.name != "none":
+                # the gradient collective here is the implicit AD-transpose
+                # psum over (data, seq) — the codec applies as a
+                # quantize→dequantize roundtrip with an all-axes-invariant
+                # key (see codec_key above)
+                grads = codec.roundtrip(grads, rng=codec_key)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             axes = (data_axis, seq_axis) if lm else data_axis

@@ -22,6 +22,7 @@ from jax.sharding import PartitionSpec as P
 
 from distributed_tensorflow_tpu.engines.base import Engine, TrainState, make_loss_fn
 from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel import compression
 
 
 class SyncEngine(Engine):
@@ -32,7 +33,12 @@ class SyncEngine(Engine):
     tests/test_engines.py), but peak activation memory drops ~K×.  This is
     the standard large-batch-beyond-HBM device-side technique; the reference
     has no counterpart (its batch lives on the host and grads stream out
-    per-batch, reference client.py:78-95)."""
+    per-batch, reference client.py:78-95).
+
+    ``grad_compression`` routes the gradient allreduce through a codec
+    (parallel/compression.py): 'none' keeps the exact pre-codec program
+    (``_build_step_exact``); bf16/int8 build a separate step whose ONE
+    explicit collective is the codec's (``_build_step_compressed``)."""
 
     def __init__(self, *args, grad_accum: int = 1, **kw):
         if grad_accum < 1:
@@ -41,6 +47,14 @@ class SyncEngine(Engine):
         self.grad_accum = grad_accum
 
     def _build_step(self):
+        if self.grad_codec.name == "none":
+            return self._build_step_exact()
+        return self._build_step_compressed()
+
+    def _build_step_exact(self):
+        """The uncompressed program, UNTOUCHED by the codec work — so
+        ``--grad-compression none`` stays bitwise identical to the
+        pre-codec engine (acceptance-tested at k=1 and k=8)."""
         loss_fn = make_loss_fn(self.model.apply)
         tx, axis, K = self.tx, self.axis, self.grad_accum
 
@@ -121,5 +135,85 @@ class SyncEngine(Engine):
             device_step, mesh=self.mesh,
             in_specs=(P(), P(self.axis), P(self.axis)),
             out_specs=(P(), P()),
+        )
+        return jax.jit(smapped, donate_argnums=0)
+
+    def _build_step_compressed(self):
+        """Codec-active step: gradients stay device-local through AD and
+        the ONE explicit collective is the codec's — encode on-device,
+        reduce in the codec's wire dtype, widen back to f32 for the
+        optimizer after.  The 1/(n·K) loss scale makes the codec's sum the
+        global batch-mean gradient, exactly as the exact path's psum.
+
+        Built with ``check_vma=False`` (like the async/gossip engines):
+        the int8 codec's two-phase reduce ends in an ``all_gather``, whose
+        output is replicated in VALUE but not provably so to the static
+        replication checker — with checking off, shard_map also inserts no
+        automatic AD-transpose psum at the replicated-params boundary, so
+        the gradients reach the codec device-local with no ``pcast``
+        bookkeeping.  Correctness is covered by the compressed-vs-exact
+        closeness and k-parity tests (tests/test_compression.py)."""
+        loss_fn = make_loss_fn(self.model.apply)
+        tx, axis, K = self.tx, self.axis, self.grad_accum
+        codec = self.grad_codec
+
+        def device_step(state: TrainState, x, y):
+            rng = self._per_device_rng(state.rng, state.step)
+            n = jax.lax.axis_size(axis)
+            # per-device key for the codec's stochastic rounding: each
+            # device quantizes its LOCAL gradient independently before the
+            # exchange (that independence is what makes the rounding noise
+            # average out across the ring)
+            codec_key = compression.codec_rng(rng)
+
+            def scaled_loss(params, xc, yc, rng_c):
+                loss, acc = loss_fn(params, xc, yc, rng_c)
+                # same 1/(n·K) scale as the exact path: the codec's SUM of
+                # per-device (per-microbatch) grads is the global mean
+                return loss / (n * K), (loss, acc)
+
+            grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
+
+            if K == 1:
+                (_, (loss, acc)), g_local = grad_fn(state.params, x, y, rng)
+            else:
+                if x.shape[0] % K:
+                    raise ValueError(
+                        f"per-device batch {x.shape[0]} not divisible by "
+                        f"grad_accum {K}")
+                xm = x.reshape((K, x.shape[0] // K) + x.shape[1:])
+                ym = y.reshape((K, y.shape[0] // K) + y.shape[1:])
+
+                def micro(carry, chunk):
+                    g_acc, l_acc, a_acc, i = carry
+                    xc, yc = chunk
+                    # independent dropout per microbatch, like separate steps
+                    (_, (l, a)), g = grad_fn(state.params, xc, yc,
+                                             jax.random.fold_in(rng, i))
+                    return (jax.tree.map(jnp.add, g_acc, g),
+                            l_acc + l, a_acc + a, i + 1), None
+
+                zero = jnp.zeros((), jnp.float32)
+                init = (jax.tree.map(jnp.zeros_like, state.params),
+                        zero, zero, jnp.zeros((), jnp.int32))
+                (g_local, loss, acc, _), _ = jax.lax.scan(micro, init,
+                                                          (xm, ym))
+                loss, acc = loss / K, acc / K
+
+            # the whole cross-device cost: one compressed allreduce
+            grads = codec.all_reduce_sum(g_local, axis, rng=codec_key)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            metrics = coll.all_reduce_mean({"loss": loss, "accuracy": acc}, axis)
+            new_state = state.replace(
+                step=state.step + 1, params=params, opt_state=opt_state)
+            return new_state, metrics
+
+        smapped = jax.shard_map(
+            device_step, mesh=self.mesh,
+            in_specs=(P(), P(self.axis), P(self.axis)),
+            out_specs=(P(), P()),
+            check_vma=False,  # value-replicated outputs the checker can't
+            #                   prove (gather-based codec collectives)
         )
         return jax.jit(smapped, donate_argnums=0)

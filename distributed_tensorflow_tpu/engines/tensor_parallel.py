@@ -26,6 +26,7 @@ import flax.linen as nn
 from distributed_tensorflow_tpu.engines.base import (
     Engine, TrainState, gspmd_value_and_grad, make_loss_fn)
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
+from distributed_tensorflow_tpu.parallel import compression
 
 
 class TPMLP(nn.Module):
@@ -77,13 +78,14 @@ class TensorParallelEngine(Engine):
     """
 
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
-                 grad_accum: int = 1):
+                 grad_accum: int = 1, grad_compression: str = "none"):
         if mesh is None or set(mesh.axis_names) != {meshlib.DATA_AXIS,
                                                     meshlib.MODEL_AXIS}:
             raise ValueError("TensorParallelEngine requires a ('data','model') mesh")
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
-        super().__init__(model, optimizer, mesh, learning_rate)
+        super().__init__(model, optimizer, mesh, learning_rate,
+                         grad_compression=grad_compression)
         self.grad_accum = grad_accum
 
     def init_state(self, rng, sample_x) -> TrainState:
@@ -92,11 +94,18 @@ class TensorParallelEngine(Engine):
     def _build_step(self):
         loss_fn = make_loss_fn(self.model.apply)
         tx, K = self.tx, self.grad_accum
+        codec = self.grad_codec
 
         def train_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
             grads, loss, acc = gspmd_value_and_grad(
                 loss_fn, state.params, x, y, rng, K, mesh=self.mesh)
+            if codec.name != "none":
+                # GSPMD inserts the data-axis gradient all-reduce itself,
+                # so the codec applies as a quantize→dequantize roundtrip
+                # (compressed-exchange numerics; parallel/compression.py)
+                grads = codec.roundtrip(
+                    grads, rng=compression.codec_rng(rng))
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             return state.replace(step=state.step + 1, params=params,

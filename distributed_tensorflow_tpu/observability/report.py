@@ -38,10 +38,18 @@ def build_run_report(fit_result: dict[str, Any], *,
         "schema_version": SCHEMA_VERSION,
         "steps": fit_result.get("steps"),
         "elapsed_s": elapsed or None,
-        # resolved drain shape + the chunk lengths actually dispatched
+        # resolved drain shape + the chunk lengths actually dispatched,
+        # and WHY auto mode downshifted when it did (None: no clamp)
         "steps_per_call": fit_result.get("steps_per_call"),
+        "steps_per_call_clamp": fit_result.get("steps_per_call_clamp"),
         "chunk_sizes": fit_result.get("chunk_sizes"),
         "prefetch_depth": fit_result.get("prefetch_depth"),
+        # gradient-collective payload: wire bytes under --grad-compression
+        # vs the raw (uncompressed) figure (None: stateless engine)
+        "grad_allreduce_bytes": fit_result.get("grad_allreduce_bytes"),
+        "grad_allreduce_bytes_raw": fit_result.get(
+            "grad_allreduce_bytes_raw"),
+        "grad_compression": fit_result.get("grad_compression"),
         # steady-state percentiles (compile excluded — see StepTimer)
         "compile_s": st.get("compile_s", st.get("first_step_s")),
         "step_time_p50_s": st.get("steady_p50_s"),

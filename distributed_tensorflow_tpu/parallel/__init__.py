@@ -1,3 +1,5 @@
-"""Parallelism layer: mesh runtime (L0) and collectives (L1)."""
+"""Parallelism layer: mesh runtime (L0), collectives (L1), and the
+gradient-compression codecs that shrink what the collectives carry."""
 
-from distributed_tensorflow_tpu.parallel import collectives, mesh  # noqa: F401
+from distributed_tensorflow_tpu.parallel import (  # noqa: F401
+    collectives, compression, mesh)

@@ -57,6 +57,13 @@ class ExperimentConfig:
     grad_accum: int = 1             # microbatches accumulated per optimizer
                                     # step (sync/allreduce engines): ~K× less
                                     # activation memory at identical math
+    grad_compression: str = "none"  # cross-device gradient/parameter
+                                    # exchange codec: none | bf16 | int8
+                                    # (parallel/compression.py; pipeline
+                                    # modes reject it)
+    compile_cache: str | None = None  # persistent XLA compilation cache
+                                    # dir (jax_compilation_cache_dir):
+                                    # repeat runs skip recompiles
     weight_decay: float = 0.0       # >0: AdamW decoupled weight decay
     clip_norm: float = 0.0          # >0: clip gradients to this global norm
                                     # before the optimizer update
@@ -142,6 +149,31 @@ class ExperimentConfig:
                                            # test split per sampled row
 
 
+def enable_compile_cache(directory: str | os.PathLike) -> str:
+    """Point XLA's persistent compilation cache at ``directory``
+    (``--compile-cache``): repeat runs — and bench warmups — reuse the
+    compiled executables of unchanged programs instead of re-tracing and
+    re-compiling them.  Creates the directory, drops jax's minimum-compile-
+    time/entry-size gates so even fast CPU-test compiles persist (the gates
+    exist to avoid caching trivia; a user who passed a cache dir wants
+    hits), and returns the resolved path.  Safe to call before or after
+    backend initialization — the cache dir is read per compile."""
+    import pathlib
+
+    import jax
+
+    path = pathlib.Path(directory)
+    path.mkdir(parents=True, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    for knob, value in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                        ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(knob, value)
+        except Exception:  # knob not present on this jax — cache still on
+            pass
+    return str(path)
+
+
 @dataclasses.dataclass
 class _Experiment:
     """Resolved experiment: mesh, data, model, engine, global batch.
@@ -192,6 +224,24 @@ def _setup(config: ExperimentConfig) -> _Experiment:
             "--router-z-weight is applied by the MoE-aware engines; "
             "without --expert-parallel > 1 (or a tp×sp composite with "
             "--model-arg moe_experts=N) it would be silently ignored")
+    if config.grad_compression != "none":
+        from distributed_tensorflow_tpu.parallel import compression
+
+        # fail on typos here, not deep inside an engine constructor
+        compression.make_codec(config.grad_compression)
+        if config.pipeline_parallel > 1:
+            # named rejection, not a silent gap: the pipeline schedules'
+            # data-axis gradient reduce rides the manual (data, pipe)
+            # shard_map with per-stage param ownership — there is no
+            # single post-AD gradient tree to run the codec over, and
+            # silently training uncompressed would misreport the wire
+            # bytes the flag promises to shrink
+            raise ValueError(
+                "--grad-compression is implemented for the data-parallel "
+                "and GSPMD engines (sync/async/allreduce/gossip/fsdp, -tp, "
+                "-sp, -ep and their composites); the pipeline schedules "
+                "(-pp) are not supported yet — drop the flag or train "
+                "without -pp")
     if config.sample_tokens:
         # pipeline runs sample too (sequential-forward decode over the
         # pipe-stacked stages, engines/pipeline.py generate); family/shape
@@ -260,7 +310,8 @@ def _setup(config: ExperimentConfig) -> _Experiment:
 
     engine_kw: dict[str, Any] = dict(
         mesh=mesh, learning_rate=config.learning_rate,
-        optimizer=_make_optimizer(config, train_ds, global_batch))
+        optimizer=_make_optimizer(config, train_ds, global_batch),
+        grad_compression=config.grad_compression)
     if config.engine == "async":
         engine_kw["sync_every"] = config.sync_every
     elif config.engine == "gossip":
@@ -537,7 +588,8 @@ def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
         model, mesh=mesh, learning_rate=config.learning_rate,
         optimizer=_make_optimizer(config, train_ds,
                                   _global_batch(config, dp)),
-        grad_accum=config.grad_accum)
+        grad_accum=config.grad_accum,
+        grad_compression=config.grad_compression)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name=f"seq_parallel[{config.attention_impl}]")
@@ -580,7 +632,8 @@ def _setup_tensor_parallel(config: ExperimentConfig) -> _Experiment:
         model, mesh=mesh, learning_rate=config.learning_rate,
         optimizer=_make_optimizer(config, train_ds,
                                   _global_batch(config, dp)),
-        grad_accum=config.grad_accum)
+        grad_accum=config.grad_accum,
+        grad_compression=config.grad_compression)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name="tensor_parallel")
@@ -605,7 +658,8 @@ def _setup_fsdp_tp(config: ExperimentConfig) -> _Experiment:
         model, mesh=mesh, learning_rate=config.learning_rate,
         optimizer=_make_optimizer(config, train_ds,
                                   _global_batch(config, dp)),
-        grad_accum=config.grad_accum)
+        grad_accum=config.grad_accum,
+        grad_compression=config.grad_compression)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name="fsdp_tp[fsdp*tp]")
@@ -771,7 +825,8 @@ def _setup_composite(config: ExperimentConfig) -> _Experiment:
                                   _global_batch(config, dp)),
         aux_weight=config.aux_weight,
         router_z_weight=config.router_z_weight,
-        grad_accum=config.grad_accum)
+        grad_accum=config.grad_accum,
+        grad_compression=config.grad_compression)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name=f"composite[dp*tp*sp,{config.attention_impl}]")
@@ -998,7 +1053,8 @@ def _setup_expert_parallel(config: ExperimentConfig,
                                   _global_batch(config, n_token_shards)),
         aux_weight=config.aux_weight,
         router_z_weight=config.router_z_weight,
-        grad_accum=config.grad_accum)
+        grad_accum=config.grad_accum,
+        grad_compression=config.grad_compression)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine,
                        global_batch=_global_batch(config, n_token_shards),
@@ -1105,7 +1161,8 @@ def _setup_expert_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
                                   _global_batch(config, dp)),
         aux_weight=config.aux_weight,
         router_z_weight=config.router_z_weight,
-        grad_accum=config.grad_accum)
+        grad_accum=config.grad_accum,
+        grad_compression=config.grad_compression)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name=(f"expert_tp_sp[dp*ep*tp*sp,{config.attention_impl}]" if tp > 1
@@ -1132,6 +1189,10 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     if config.watchdog_abort and config.watchdog_timeout <= 0:
         raise ValueError("watchdog_abort requires watchdog_timeout > 0 "
                          "(nothing would ever detect the stall)")
+    if config.compile_cache:
+        # before any compile: the whole run's programs become cache hits
+        # on the next invocation with the same cache dir
+        enable_compile_cache(config.compile_cache)
     ex = _setup(config)
     n, train_ds, test_ds = ex.n, ex.train_ds, ex.test_ds
     global_batch = ex.global_batch
