@@ -204,7 +204,8 @@ def ensure_backend(metric: str) -> None:
 # default mode: training throughput + MFU
 # ---------------------------------------------------------------------------
 
-def bench_throughput(grad_compression: str = "none") -> None:
+def bench_throughput(grad_compression: str = "none",
+                     health: str = "off") -> None:
     import jax
 
     from distributed_tensorflow_tpu.data.loaders import load_dataset
@@ -224,6 +225,9 @@ def bench_throughput(grad_compression: str = "none") -> None:
     # and wins on transformer-scale matmuls (see tests/test_models.py).
     model = create_model("cnn", num_classes=ds.num_classes)
     eng = SyncEngine(model, mesh=mesh, grad_compression=grad_compression)
+    if health == "on":
+        # before init_state: the optimizer tree gains its capture slots
+        eng.enable_health()
 
     rng = np.random.default_rng(0)
     idx = rng.integers(0, len(ds.x), global_batch)
@@ -248,12 +252,13 @@ def bench_throughput(grad_compression: str = "none") -> None:
     # the stacked inputs at ~512 MB/chip (mnist b=512 → the full 100)
     batch_bytes = max(x.nbytes + y.nbytes, 1)
     unit_len = max(8, min(SCAN_SHORT, (512 << 20) // batch_bytes))
-    unit = eng.build_many_step(unit_len)
     xs_k, ys_k = (xs,) * unit_len, (ys,) * unit_len
     calls_long = max(SCAN_LONG // unit_len, 2)
 
     def run_unit(st):
-        st, _metrics = unit(st, xs_k, ys_k)
+        # many_step caches the compiled drain per k and threads the health
+        # layer's loss-EMA carry when --health on — same production program
+        st, _metrics = eng.many_step(st, xs_k, ys_k)
         return st
 
     state = run_unit(state)  # compile outside the window
@@ -362,6 +367,13 @@ def bench_throughput(grad_compression: str = "none") -> None:
         "grad_bytes_per_step_wire": eng.grad_collective_bytes(state),
         "grad_bytes_per_step_raw": eng.grad_collective_bytes_raw(state),
         "grad_compression": eng.grad_codec.name,
+        # numeric-health summary of the Trainer-path window (--health on):
+        # the same section the fit result / run report carry
+        **({"health_max_update_ratio":
+                (last_fit.get("health") or {}).get("max_update_ratio"),
+            "health_anomaly_steps":
+                (last_fit.get("health") or {}).get("anomaly_steps")}
+           if health == "on" else {}),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "flops_per_example_analytic": int(flops_ex),
         "xla_flops_per_step": xla_flops,
@@ -377,7 +389,8 @@ def bench_throughput(grad_compression: str = "none") -> None:
 # --stream: input pipeline (fresh host batches per step)
 # ---------------------------------------------------------------------------
 
-def bench_stream(steps: int = 100, grad_compression: str = "none") -> None:
+def bench_stream(steps: int = 100, grad_compression: str = "none",
+                 health: str = "off") -> None:
     """Training throughput when every step consumes a FRESH host batch —
     the configuration the C++ prefetcher (native/src/pipeline.cc) exists
     for.  'resident' (one device batch reused, the default bench) bounds the
@@ -397,6 +410,8 @@ def bench_stream(steps: int = 100, grad_compression: str = "none") -> None:
     ds = load_dataset("mnist", split="train")
     model = create_model("cnn", num_classes=ds.num_classes)
     eng = SyncEngine(model, mesh=mesh, grad_compression=grad_compression)
+    if health == "on":
+        eng.enable_health()  # before init_state: capture slots in tx.init
     state = eng.init_state(jax.random.key(0), ds.x[:n])
 
     def run_epoch_stream(native: bool | None, st, max_steps: int):
@@ -504,6 +519,11 @@ def bench_stream(steps: int = 100, grad_compression: str = "none") -> None:
         "grad_bytes_per_step_wire": eng.grad_collective_bytes(state),
         "grad_bytes_per_step_raw": eng.grad_collective_bytes_raw(state),
         "grad_compression": eng.grad_codec.name,
+        **({"health_max_update_ratio":
+                (trainer_fit.get("health") or {}).get("max_update_ratio"),
+            "health_anomaly_steps":
+                (trainer_fit.get("health") or {}).get("anomaly_steps")}
+           if health == "on" else {}),
         "trainer_examples_per_sec": round(
             trainer_fit["examples"] / trainer_fit["elapsed"], 1),
         **{f"producer_{k}_rows_per_sec": round(v, 1)
@@ -927,6 +947,12 @@ def main() -> None:
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent XLA compilation cache dir — repeat "
                         "bench invocations skip the warmup recompiles")
+    p.add_argument("--health", default="off", choices=["off", "on"],
+                   help="numeric-health layer for the default/--stream "
+                        "training benches (observability/health.py): the "
+                        "JSON line gains health_max_update_ratio + "
+                        "health_anomaly_steps from the Trainer-path "
+                        "window's fit result")
     args = p.parse_args()
     if args.compile_cache:
         from distributed_tensorflow_tpu.utils.harness import (
@@ -942,7 +968,8 @@ def main() -> None:
     try:
         if mode == "stream":
             bench_stream(steps=max(args.steps, 1),
-                         grad_compression=args.grad_compression)
+                         grad_compression=args.grad_compression,
+                         health=args.health)
         elif mode == "attention":
             bench_attention()
         elif mode == "lm":
@@ -952,7 +979,8 @@ def main() -> None:
         elif mode == "decode":
             bench_decode()
         else:
-            bench_throughput(grad_compression=args.grad_compression)
+            bench_throughput(grad_compression=args.grad_compression,
+                             health=args.health)
     except Exception as e:  # noqa: BLE001 — the artifact must stay parsable
         import traceback
         tb = traceback.format_exc()

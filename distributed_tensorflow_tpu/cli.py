@@ -272,8 +272,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="on stall, exit(75) after reporting so a supervisor "
                         "can relaunch with --resume (a wedged XLA runtime "
                         "cannot be recovered in-process)")
+    p.add_argument("--health", default="off", choices=["off", "on"],
+                   help="per-step numeric training-health stats, computed "
+                        "ON DEVICE inside the jitted scan and stacked "
+                        "through the trajectory like metrics (zero "
+                        "downshift): global grad norm, param norm, update "
+                        "ratio, non-finite leaf count, loss-spike score "
+                        "vs a running EMA (observability/health.py).  "
+                        "They ride --metrics-path records and feed "
+                        "--on-anomaly; 'off' (default) compiles the exact "
+                        "pre-health program")
+    p.add_argument("--on-anomaly", default="warn", choices=["warn", "halt"],
+                   dest="on_anomaly",
+                   help="with --health on: response to a per-step health "
+                        "anomaly (non-finite params/grads, update-ratio "
+                        "ceiling, loss spike) — 'warn' records structured "
+                        "anomaly trace events and a health summary, "
+                        "'halt' additionally stops at the offending step. "
+                        " Subsumes the loss-only nan guard (README "
+                        "'Health monitoring')")
     p.add_argument("--no-nan-guard", action="store_true",
-                   help="disable the divergence (NaN/inf loss) check")
+                   help="disable the fatal divergence (NaN/inf) response: "
+                        "without --health, skips the legacy loss-only "
+                        "check; with --health on + --on-anomaly warn, "
+                        "downgrades nonfinite anomalies (which stay fatal "
+                        "by default) to record-and-continue")
     p.add_argument("--max-restarts", type=int, default=0,
                    help=">0: on crash, restart from the latest checkpoint up "
                         "to N times (requires --checkpoint-dir + "
@@ -392,6 +415,8 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         watchdog_timeout=args.watchdog_timeout,
         watchdog_abort=args.watchdog_abort,
         nan_guard=not args.no_nan_guard,
+        health=args.health,
+        on_anomaly=args.on_anomaly,
         max_restarts=args.max_restarts,
         sample_tokens=args.sample,
         sample_prompt_len=args.sample_prompt_len,

@@ -111,7 +111,8 @@ class Trainer:
             max_steps: int | None = None, eval_ds=None,
             target_accuracy: float | None = None, eval_every: int = 50,
             eval_batch: int = 100, steps_per_call: int | None = None,
-            prefetch: int = 2, tracer=None) -> dict:
+            prefetch: int = 2, tracer=None,
+            on_anomaly: str = "warn") -> dict:
         """Train; returns {'elapsed': seconds_around_fit, 'steps': n, ...} —
         the reference's only training metrics (reference dist_keras.py:41-49).
 
@@ -123,6 +124,18 @@ class Trainer:
         window and the watchdog's on_stall callback fires).
         ``nan_guard``: divergence check on metrics already materialized at
         the logging cadence (no extra device syncs; utils/failure.py).
+        When the engine's health layer is on (``Engine.enable_health`` /
+        ``--health on``) the per-step anomaly policy SUBSUMES this
+        loss-only guard: every step's on-device health stats (grad norm,
+        update ratio, non-finite leaf count, loss spike —
+        observability/health.py) are checked host-side at chunk flush,
+        and ``on_anomaly`` decides the response — ``'warn'`` records
+        structured ``anomaly`` trace events and a ``health`` summary in
+        the result, ``'halt'`` additionally raises ``AnomalyDetected`` at
+        the offending step.  (At ``steps_per_call == 1`` the policy
+        materializes each step's metrics — step-exact detection at the
+        cost of a per-step host sync; the chunked drain keeps the
+        zero-downshift contract.)
         ``tracer``: an observability.Tracer — spans ``compile`` /
         ``chunk_dispatch`` / ``materialize`` / ``checkpoint`` / ``eval``
         plus prefetch queue-depth gauges at chunk boundaries; defaults to
@@ -153,10 +166,76 @@ class Trainer:
         trajectory is step-for-step identical to ``steps_per_call=1`` on
         the same seed.
         """
+        from distributed_tensorflow_tpu.observability import health as healthlib
         from distributed_tensorflow_tpu.observability.trace import NULL_TRACER
-        from distributed_tensorflow_tpu.utils.failure import check_finite
+        from distributed_tensorflow_tpu.utils.failure import (
+            AnomalyDetected, check_finite)
         if tracer is None:
             tracer = NULL_TRACER
+        if on_anomaly not in ("warn", "halt"):
+            raise ValueError(
+                f"on_anomaly must be 'warn' or 'halt', got '{on_anomaly}'")
+        # health policy state: the engine's health layer (enable_health)
+        # carries the per-step stats; the anomaly decisions live here.
+        # With health on, the loss-only nan_guard's CADENCE checks are
+        # subsumed — but its fail-fast SEMANTIC survives as the alias:
+        # divergence ('nonfinite' anomalies) stays fatal under
+        # on_anomaly='warn' unless nan_guard was explicitly disabled, so
+        # adding --health never silently downgrades a NaN'd run from
+        # abort to train-to-completion.  'halt' makes every anomaly kind
+        # fatal; 'warn' + nan_guard=False observes only (MIGRATING.md).
+        health_cfg = getattr(self.engine, "health", None)
+        guard_divergence = nan_guard
+        nan_guard = nan_guard and health_cfg is None
+        h_max: dict = {}
+        anomaly_steps: list[int] = []
+        first_anomaly = None
+        n_anomalies = 0
+        warned_anomaly = False
+
+        def note_health(gstep: int, floats: dict) -> None:
+            """Per-step anomaly policy over materialized health floats:
+            update the run maxima, emit one structured ``anomaly`` trace
+            event per offending stat, and on 'halt' raise at THIS step —
+            the metrics record was already logged (record first, so the
+            diverging step's numbers reach the sink)."""
+            nonlocal first_anomaly, n_anomalies, warned_anomaly
+            for stat in ("grad_norm", "update_ratio", "loss_spike"):
+                v = floats.get(stat)
+                if v is not None and math.isfinite(v):
+                    h_max[stat] = max(h_max.get(stat, v), v)
+            anomalies = healthlib.detect_anomalies(floats, health_cfg)
+            if not anomalies:
+                return
+            n_anomalies += len(anomalies)
+            if first_anomaly is None:
+                first_anomaly = gstep
+            if len(anomaly_steps) < 64:  # bounded: a NaN'd run flags every
+                anomaly_steps.append(gstep)  # step until it ends
+            for a in anomalies:
+                tracer.event("anomaly", step=gstep, policy=on_anomaly, **a)
+            a = anomalies[0]
+            if on_anomaly == "halt":
+                raise AnomalyDetected(
+                    f"health anomaly at step {gstep}: {a['stat']}="
+                    f"{a['value']} ({a['reason']}; limit {a['limit']}) — "
+                    f"halted by on_anomaly='halt'")
+            diverged = [x for x in anomalies if x["kind"] == "nonfinite"]
+            if guard_divergence and diverged:
+                # the nan_guard alias: divergence is fatal even under
+                # 'warn' (now step-exact, vs the old log-cadence check);
+                # --no-nan-guard opts into observe-only
+                d = diverged[0]
+                raise AnomalyDetected(
+                    f"training diverged at step {gstep}: {d['stat']}="
+                    f"{d['value']} ({d['reason']}) — fatal under the "
+                    f"nan-guard default; pass nan_guard=False "
+                    f"(--no-nan-guard) to record and continue")
+            if not warned_anomaly:
+                warned_anomaly = True
+                log_fn(f"step {gstep}  ANOMALY {a['stat']}={a['value']} "
+                       f"({a['reason']}) — continuing under "
+                       f"on_anomaly='warn'")
         if target_accuracy is not None and eval_ds is None:
             raise ValueError("target_accuracy requires eval_ds (nothing "
                              "would ever be evaluated against the target)")
@@ -359,8 +438,18 @@ class Trainer:
                         gstep = start_step + steps
                         examples += bs  # global examples per step
                         dev_metrics = metrics
-                        record_step(gstep, lambda: {
-                            kk: float(v) for kk, v in dev_metrics.items()})
+                        if health_cfg is not None:
+                            # the anomaly policy needs this step's values:
+                            # materialize now (per-step sync — the honest
+                            # cost of step-exact detection at k=1; the
+                            # chunked drain pays one sync per chunk)
+                            floats = {kk: float(v)
+                                      for kk, v in dev_metrics.items()}
+                            record_step(gstep, lambda f=floats: f)
+                            note_health(gstep, floats)
+                        else:
+                            record_step(gstep, lambda: {
+                                kk: float(v) for kk, v in dev_metrics.items()})
                         if checkpoint_manager is not None and \
                                 checkpoint_every and \
                                 gstep % checkpoint_every == 0:
@@ -423,6 +512,8 @@ class Trainer:
                             m = {kk: float(v[i]) for kk, v in floats.items()}
                             metrics = m
                             record_step(gstep, lambda m=m: m)
+                            if health_cfg is not None:
+                                note_health(gstep, m)
 
                     dispatched = steps
                     next_chunk = pf.take(k if max_steps is None
@@ -533,6 +624,18 @@ class Trainer:
             **({"watchdog_beats": watchdog.beats,
                 "watchdog_stalls": watchdog.stall_episodes}
                if watchdog is not None else {}),
+            # numeric-health summary (engine health layer on): run maxima
+            # of the per-step stats plus the anomaly record — the section
+            # the run report / bench carry forward
+            **({"health": {
+                "on_anomaly": on_anomaly,
+                "anomalies": n_anomalies,
+                "anomaly_steps": anomaly_steps,
+                "first_anomaly_step": first_anomaly,
+                "max_grad_norm": h_max.get("grad_norm"),
+                "max_update_ratio": h_max.get("update_ratio"),
+                "max_loss_spike": h_max.get("loss_spike"),
+            }} if health_cfg is not None else {}),
             "start_step": start_step, "examples": examples,
             "examples_per_sec": examples / elapsed if elapsed > 0 else 0.0,
             **({"reached_target": reached, "eval_accuracy": eval_acc,

@@ -191,6 +191,12 @@ class Engine:
         self._eval_fn = None
         self._many_step_fns: dict[int, Callable] = {}  # k → jitted scan drain
         self._init_shardings = None  # set by _init_partitioned_state
+        # numeric-health layer (observability/health.py): None = off — no
+        # optimizer wrap, no extra metrics, the compiled program is the
+        # pre-health one.  enable_health() installs the capture transforms.
+        self.health = None
+        self._health_step_fn = None
+        self._health_ema_val = None  # device (ema, count) loss-EMA carry
 
     # ---------------------------------------------------------------- init
     def init_state(self, rng: jax.Array, sample_x: np.ndarray) -> TrainState:
@@ -231,11 +237,87 @@ class Engine:
                          process_local)
         return xs, ys, ms
 
+    # -------------------------------------------------------------- health
+    def enable_health(self, config=None):
+        """Turn on the numeric-health layer (``--health on``): wraps the
+        optimizer with the capture transforms of observability/health.py,
+        so every subsequent step's metrics additionally carry
+        ``grad_norm / param_norm / update_norm / update_ratio /
+        nonfinite_count / loss_spike`` — computed on device, stacked
+        through the many-step scan like any other metric.
+
+        Must run BEFORE ``init_state``/the first step: the optimizer state
+        tree gains its capture slots at ``tx.init``.  With health off
+        (never called) nothing here touches the engine — the compiled
+        program stays bitwise identical to the pre-health one."""
+        from distributed_tensorflow_tpu.observability import health as hl
+
+        if self.health is not None:
+            return self.health
+        if (self._step_fn is not None or self._many_step_fns
+                or self._init_shardings is not None):
+            raise RuntimeError(
+                "enable_health() must run before the engine builds its "
+                "step program or initializes state (the optimizer tree "
+                "gains capture slots at tx.init)")
+        self.health = config if config is not None else hl.HealthConfig()
+        self.tx = hl.wrap_optimizer(self.tx, self.health)
+        return self.health
+
+    def _health_ema(self):
+        from distributed_tensorflow_tpu.observability import health as hl
+
+        if self._health_ema_val is None:
+            self._health_ema_val = hl.ema_init()
+        return self._health_ema_val
+
+    def _check_health_state(self, state) -> None:
+        """A state initialized BEFORE enable_health() carries no capture
+        slots (the replicated engines' init_state sets none of the fields
+        the enable-time guard can see) — fail at first step with the
+        actionable message instead of an opaque optax tree-structure
+        mismatch deep inside the jit."""
+        from distributed_tensorflow_tpu.observability import health as hl
+
+        hl.from_opt_state(state.opt_state)
+
+    def _health_wrap(self, step):
+        """``(state, ema, x, y) -> (state, ema, metrics ∪ health)``: run
+        the engine's step, read the captured health scalars back out of
+        the NEW opt_state, and score the loss against its running EMA —
+        all inside the jit, so the health trajectory stacks through the
+        scan exactly like loss/accuracy (k-invariant, flushed per chunk)."""
+        from distributed_tensorflow_tpu.observability import health as hl
+
+        cfg = self.health
+
+        def stepped(state, ema, x, y):
+            new_state, metrics = step(state, x, y)
+            stats = hl.from_opt_state(new_state.opt_state)
+            if "loss" in metrics:
+                spike, ema = hl.ema_spike(metrics["loss"], ema, cfg)
+                stats["loss_spike"] = spike
+            return new_state, ema, {**metrics, **stats}
+
+        return stepped
+
     # ---------------------------------------------------------------- step
     def step(self, state: TrainState, x, y):
         if self._step_fn is None:
             self._step_fn = self._build_step()
-        return self._step_fn(state, x, y)
+        if self.health is None:
+            return self._step_fn(state, x, y)
+        if self._health_step_fn is None:
+            self._check_health_state(state)
+            # the outer jit inlines the engine's jitted step; the state is
+            # donated as before (the two-scalar EMA carry is not worth
+            # donation bookkeeping)
+            self._health_step_fn = jax.jit(
+                self._health_wrap(self._step_fn), donate_argnums=0)
+        state, ema, metrics = self._health_step_fn(
+            state, self._health_ema(), x, y)
+        self._health_ema_val = ema
+        return state, metrics
 
     def _build_step(self):
         raise NotImplementedError
@@ -260,6 +342,13 @@ class Engine:
         changes (BASELINE.md methodology) happens once per *chunk* instead
         of once per step.  The scan body is the engine's own donated
         ``train_step`` — identical math step for step.
+
+        With the health layer on (``enable_health``) the signature gains
+        the loss-EMA carry — ``many(state, ema, xs_k, ys_k) -> (state,
+        ema, metrics)`` — and each ``metrics`` leaf includes the stacked
+        per-step health stats; ``many_step`` threads the carry, so callers
+        going through it see no difference.  Health OFF compiles the exact
+        pre-health program below, untouched.
         """
         if k < 1:
             raise ValueError(f"steps_per_call must be >= 1, got {k}")
@@ -267,15 +356,33 @@ class Engine:
             self._step_fn = self._build_step()
         step = self._step_fn
 
-        def many(state, xs_k, ys_k):
-            def body(st, batch):
+        if self.health is None:
+            def many(state, xs_k, ys_k):
+                def body(st, batch):
+                    x, y = batch
+                    return step(st, x, y)
+
+                return jax.lax.scan(body, state,
+                                    (jnp.stack(xs_k), jnp.stack(ys_k)))
+
+            return jax.jit(many, donate_argnums=0)
+
+        hstep = self._health_wrap(step)
+
+        def many_health(state, ema, xs_k, ys_k):
+            def body(carry, batch):
+                st, e = carry
                 x, y = batch
-                return step(st, x, y)
+                st, e, m = hstep(st, e, x, y)
+                return (st, e), m
 
-            return jax.lax.scan(body, state,
-                                (jnp.stack(xs_k), jnp.stack(ys_k)))
+            (state, ema), metrics = jax.lax.scan(
+                body, (state, ema), (jnp.stack(xs_k), jnp.stack(ys_k)))
+            return state, ema, metrics
 
-        return jax.jit(many, donate_argnums=0)
+        # state donated as in the health-off drain; the two-scalar EMA
+        # carry is not worth donation bookkeeping
+        return jax.jit(many_health, donate_argnums=0)
 
     def many_step(self, state: TrainState, xs_seq, ys_seq):
         """Run ``len(xs_seq)`` steps through the cached scanned drain
@@ -287,9 +394,16 @@ class Engine:
         k = len(xs_seq)
         fn = self._many_step_fns.get(k)
         if fn is None:
+            if self.health is not None:
+                self._check_health_state(state)
             fn = self.build_many_step(k)
             self._many_step_fns[k] = fn
-        state, metrics = fn(state, tuple(xs_seq), tuple(ys_seq))
+        if self.health is None:
+            state, metrics = fn(state, tuple(xs_seq), tuple(ys_seq))
+        else:
+            state, ema, metrics = fn(state, self._health_ema(),
+                                     tuple(xs_seq), tuple(ys_seq))
+            self._health_ema_val = ema
         monitor = getattr(self, "overflow_monitor", None)
         if monitor is not None and "overflow" in metrics:
             for i in range(k):

@@ -17,7 +17,18 @@ fast path instead of disabling it:
   report   — the end-of-run structured summary: steady-state step-time
              percentiles split from compile, chunk shapes actually used,
              watchdog heartbeat/stall counts, prefetch starvation totals,
-             sink drops, and the measured telemetry overhead itself.
+             sink drops, the health section, and the measured telemetry
+             overhead itself.
+  health   — per-step numeric-health stats computed ON DEVICE inside the
+             jitted many-step scan (grad/param/update norms, update
+             ratio, non-finite leaf count, loss-spike vs a running EMA)
+             via optimizer-level capture transforms, plus the host-side
+             anomaly policy behind ``--on-anomaly warn|halt``.
+  analyze  — the offline read side: span aggregation, stall summaries,
+             Chrome-trace-event export (Perfetto-loadable), health
+             timelines, and the run-vs-run regression diff.  Stdlib-only,
+             usable as ``python -m
+             distributed_tensorflow_tpu.observability.analyze``.
 
 Why this lives OUTSIDE the step loop's downshift logic: per-step metric
 records ride the ``lax.scan`` carry of ``Engine.build_many_step`` and are
@@ -34,8 +45,20 @@ from distributed_tensorflow_tpu.observability.trace import (
 
 __all__ = [
     "AsyncJsonlSink",
+    "HealthConfig",
     "NULL_TRACER",
     "SCHEMA_VERSION",
     "Tracer",
     "build_run_report",
 ]
+
+
+def __getattr__(name: str):
+    # lazy: health pulls in jax/optax, which the stdlib-only analyze CLI
+    # (and anything else reading JSONL offline) must not pay for
+    if name == "HealthConfig":
+        from distributed_tensorflow_tpu.observability.health import (
+            HealthConfig)
+
+        return HealthConfig
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,414 @@
+"""Offline analysis of the telemetry streams: the read side of PR 2's
+write side.  Nothing in the repo could read the JSONL files back until now
+— this module (and its CLI, ``python -m
+distributed_tensorflow_tpu.observability.analyze``) turns them into
+answers:
+
+  spans TRACE.jsonl        span aggregation + stall/starvation summary
+  export TRACE.jsonl -o F  Chrome-trace-event JSON — load F in Perfetto
+                           (https://ui.perfetto.dev) or chrome://tracing
+  health METRICS.jsonl     health timeline: first anomaly step, stat maxima
+  diff BASE NEW            run-vs-run regression diff of two run reports
+                           (or BENCH_*.json lines); exits nonzero iff a
+                           metric regressed beyond --threshold
+
+Inputs are whatever the sinks wrote: a trace JSONL (``--trace``), a metrics
+JSONL (``--metrics-path``), a result JSONL (``--result-path``), the
+harness's printed summary, or a ``bench.py`` line.  ``load_report`` accepts
+any of them — for multi-line files the LAST parsable JSON object wins (the
+summary/bench line), and a ``run_report`` found inside a summary is
+flattened into the comparison.
+
+Deliberately stdlib-only (json/math/argparse): the analyzer must run
+anywhere the JSONL files land — a laptop, a CI step — without importing
+jax or initializing any backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from pathlib import Path
+from typing import Any, Iterable
+
+
+def read_jsonl(path: str | Path) -> list[dict]:
+    """Parse a JSONL stream.  The sink's crash-durability contract is
+    whole-lines-only, so every non-empty line must parse; a torn line is a
+    real error, not something to paper over."""
+    records = []
+    for i, line in enumerate(Path(path).read_text().splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}:{i}: unparsable JSONL line "
+                             f"({e.msg})") from e
+    return records
+
+
+# ------------------------------------------------------------ span summary
+
+def span_aggregate(records: Iterable[dict]) -> dict[str, dict[str, float]]:
+    """Per-name {count, total_s, max_s, mean_s} over the span records —
+    the offline twin of Tracer.span_summary (which only exists while the
+    run's process is alive)."""
+    agg: dict[str, list] = {}
+    for rec in records:
+        if rec.get("event") != "span":
+            continue
+        a = agg.setdefault(rec["name"], [0, 0.0, 0.0])
+        a[0] += 1
+        a[1] += float(rec.get("dur_s", 0.0))
+        a[2] = max(a[2], float(rec.get("dur_s", 0.0)))
+    return {name: {"count": c, "total_s": tot, "max_s": mx,
+                   "mean_s": tot / c if c else 0.0}
+            for name, (c, tot, mx) in sorted(agg.items())}
+
+
+def trace_summary(records: list[dict]) -> dict[str, Any]:
+    """Everything the trace stream can answer offline: the span table, a
+    wall-clock estimate, counter totals, and the stall/starvation story
+    (prefetch queue-depth gauges, anomaly events, stall events)."""
+    spans = span_aggregate(records)
+    ts = [float(r["t"]) for r in records if "t" in r]
+    ends = [float(r["t"]) + float(r.get("dur_s", 0.0))
+            for r in records if "t" in r]
+    gauges = [r for r in records if r.get("event") == "gauge"
+              and r.get("name") == "prefetch_depth"]
+    counters: dict[str, int] = {}
+    for r in records:
+        if r.get("event") == "counter":
+            counters[r["name"]] = r.get("total", 0)
+    anomalies = [r for r in records if r.get("event") == "event"
+                 and r.get("name") == "anomaly"]
+    # dispatch gaps: time between consecutive chunk_dispatch span STARTS
+    # minus the span's own duration — host-side stall between dispatches
+    dispatch = sorted((float(r["t"]), float(r.get("dur_s", 0.0)))
+                      for r in records if r.get("event") == "span"
+                      and r.get("name") == "chunk_dispatch")
+    gaps = [max(b[0] - (a[0] + a[1]), 0.0)
+            for a, b in zip(dispatch, dispatch[1:])]
+    return {
+        "records": len(records),
+        "spans": spans,
+        "wall_s": (max(ends) - min(ts)) if ts else 0.0,
+        "counters": counters,
+        "stalls": {
+            "prefetch_starvation": (max(int(g.get("starvation", 0))
+                                        for g in gauges) if gauges else None),
+            "zero_depth_gauges": sum(1 for g in gauges
+                                     if not g.get("value")),
+            "gauges": len(gauges),
+            "max_dispatch_gap_s": max(gaps) if gaps else None,
+            "anomaly_events": len(anomalies),
+            "first_anomaly_step": (anomalies[0].get("step")
+                                   if anomalies else None),
+        },
+    }
+
+
+# --------------------------------------------------------- Perfetto export
+
+def _json_safe(value: Any) -> Any:
+    """Strict-JSON rendering of an arg value: Python's json module emits
+    bare ``Infinity``/``NaN`` tokens that JSON.parse (Perfetto,
+    chrome://tracing) rejects — and anomalous runs, the ones most worth
+    looking at, carry exactly those values.  Render them as strings."""
+    if isinstance(value, float) and not math.isfinite(value):
+        return repr(value)  # 'inf' / '-inf' / 'nan'
+    return value
+
+
+def to_chrome_trace(records: list[dict]) -> dict[str, Any]:
+    """Chrome-trace-event JSON (the format Perfetto and chrome://tracing
+    load): every span record becomes exactly ONE complete ('X') event —
+    the round-trip tests count on that bijection — events become instants
+    ('i'), gauges/counters become counter tracks ('C').  Timestamps are
+    the records' monotonic seconds in microseconds; pid is the JAX process
+    index (so merged pod timelines separate per process), tid the OS pid."""
+    events: list[dict] = []
+    procs: dict[int, str] = {}
+    for rec in records:
+        kind = rec.get("event")
+        if "t" not in rec or kind not in ("span", "event", "gauge",
+                                          "counter"):
+            continue
+        pid = int(rec.get("process", 0))
+        tid = int(rec.get("pid", 0))
+        procs.setdefault(pid, f"{rec.get('host', '?')} "
+                              f"(process {pid}, run {rec.get('run', '?')})")
+        ts = float(rec["t"]) * 1e6
+        drop = {"event", "name", "t", "dur_s", "run", "host", "pid",
+                "process", "schema_version"}
+        if kind in ("gauge", "counter"):
+            # only there is 'value' the counter-track payload; an EVENT's
+            # value field (e.g. an anomaly's offending stat value) is an
+            # arg the operator needs to see
+            drop.add("value")
+        args = {k: _json_safe(v) for k, v in rec.items() if k not in drop}
+        if kind == "span":
+            events.append({"name": rec["name"], "cat": "span", "ph": "X",
+                           "ts": ts, "dur": float(rec.get("dur_s", 0.0)) * 1e6,
+                           "pid": pid, "tid": tid, "args": args})
+        elif kind == "event":
+            events.append({"name": rec["name"], "cat": "event", "ph": "i",
+                           "ts": ts, "s": "t", "pid": pid, "tid": tid,
+                           "args": args})
+        elif kind == "gauge":
+            events.append({"name": rec["name"], "cat": "gauge", "ph": "C",
+                           "ts": ts, "pid": pid, "tid": tid,
+                           "args": {rec["name"]: rec.get("value", 0)}})
+        else:  # counter
+            events.append({"name": rec["name"], "cat": "counter", "ph": "C",
+                           "ts": ts, "pid": pid, "tid": tid,
+                           "args": {rec["name"]: rec.get("total", 0)}})
+    events.sort(key=lambda e: e["ts"])
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "ts": 0,
+             "args": {"name": label}} for pid, label in sorted(procs.items())]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+# ----------------------------------------------------------- health files
+
+def health_timeline(records: list[dict], *,
+                    max_update_ratio: float = 1.0,
+                    loss_spike_factor: float = 10.0) -> dict[str, Any]:
+    """Summary of a metrics stream carrying the health keys (or a trace
+    stream carrying ``anomaly`` events): first anomaly step, run maxima,
+    and the non-finite/threshold step counts — the offline twin of the
+    fit result's ``health`` section, recomputable from the file alone.
+
+    The threshold kwargs mirror ``HealthConfig``'s defaults (this module
+    stays stdlib-only, so it cannot import the jax-backed config class) —
+    pass the run's actual thresholds when they were customized."""
+    first = None
+    nonfinite_steps = 0
+    threshold_steps = 0
+    maxima: dict[str, float] = {}
+    steps = 0
+    anomaly_steps: list[int] = []
+    for rec in records:
+        if rec.get("event") == "event" and rec.get("name") == "anomaly":
+            step = rec.get("step")
+            if step is not None and step not in anomaly_steps:
+                anomaly_steps.append(step)
+            continue
+        if "event" in rec or "step" not in rec:
+            # trace records (spans/gauges/counters) may carry a 'step'
+            # attr (checkpoint/eval spans do) but are not health steps —
+            # only metric records (no 'event' envelope) count
+            continue
+        steps += 1
+        nonfinite = bool(rec.get("nonfinite_count"))
+        crossed = False
+        for key in ("grad_norm", "param_norm", "update_norm",
+                    "update_ratio", "loss_spike", "loss"):
+            v = rec.get(key)
+            if v is None:
+                continue
+            if not math.isfinite(v):
+                nonfinite = True
+                continue
+            if key != "loss":
+                maxima[key] = max(maxima.get(key, v), v)
+            if key == "update_ratio" and v > max_update_ratio:
+                crossed = True
+            if key == "loss_spike" and v > loss_spike_factor:
+                crossed = True
+        nonfinite_steps += nonfinite
+        threshold_steps += (crossed and not nonfinite)
+        if (nonfinite or crossed) and first is None:
+            first = rec["step"]
+    if anomaly_steps and (first is None or anomaly_steps[0] < first):
+        first = anomaly_steps[0]
+    return {
+        "steps": steps,
+        "first_anomaly_step": first,
+        "nonfinite_steps": nonfinite_steps,
+        "threshold_steps": threshold_steps,
+        "anomaly_events": len(anomaly_steps),
+        **{f"max_{k}": v for k, v in sorted(maxima.items())},
+    }
+
+
+# ------------------------------------------------------------ run-vs-run
+
+# (key, better-direction) pairs the differ compares when present+numeric in
+# BOTH reports.  Covers run reports, fit summaries AND bench.py lines —
+# one table so a BENCH_*.json trajectory can be diffed against a run.
+_DIFF_METRICS: tuple[tuple[str, str], ...] = (
+    ("step_time_p50_s", "lower"), ("step_time_p95_s", "lower"),
+    ("step_time_mean_s", "lower"), ("compile_s", "lower"),
+    ("elapsed_s", "lower"), ("telemetry_overhead_frac", "lower"),
+    ("grad_allreduce_bytes", "lower"),
+    ("examples_per_sec", "higher"), ("examples_per_sec_per_device", "higher"),
+    ("test_accuracy", "higher"),
+    # bench.py line vocabulary ("value"'s direction is resolved per line —
+    # see _value_direction; today's value-bearing bench metrics are rates)
+    ("step_time_p50", "lower"), ("step_time_p95", "lower"),
+    ("prefetch_starvation", "lower"), ("grad_bytes_per_step_wire", "lower"),
+    ("dispatch_value", "higher"), ("trainer_examples_per_sec", "higher"),
+    ("mfu", "higher"),
+    # health: anomaly count (flattened from the health section below)
+    ("health_anomalies", "lower"),
+)
+
+
+def load_report(path: str | Path) -> dict[str, Any]:
+    """One comparable dict from any artifact this repo writes: a JSON
+    object, or a JSONL stream whose LAST parsable object wins (result
+    sinks append the summary last; bench prints one line).  A nested
+    ``run_report`` is flattened under the summary's own keys, and the
+    ``health`` section's anomaly count surfaces as ``health_anomalies``."""
+    text = Path(path).read_text()
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        obj = None
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        if obj is None:
+            raise ValueError(f"{path}: no parsable JSON object found")
+    if not isinstance(obj, dict):
+        raise ValueError(f"{path}: expected a JSON object, got "
+                         f"{type(obj).__name__}")
+    flat = dict(obj)
+    nested = obj.get("run_report")
+    if isinstance(nested, dict):
+        # summary keys win where both exist (they are the same numbers)
+        flat = {**nested, **{k: v for k, v in obj.items()
+                             if k != "run_report"}}
+    health = flat.get("health")
+    if isinstance(health, dict) and "anomalies" in health:
+        flat.setdefault("health_anomalies", health["anomalies"])
+    return flat
+
+
+def _value_direction(report: dict[str, Any]) -> str:
+    """Better-direction of a bench line's headline ``value``, resolved
+    from the line itself: time-valued metrics/units (ms, seconds) are
+    lower-is-better, rates (the current bench vocabulary — examples/sec,
+    tokens/sec) higher.  Hard-coding 'higher' would invert the verdict
+    the day a time-valued bench metric gains a headline value."""
+    probe = f"{report.get('metric', '')} {report.get('unit', '')}".lower()
+    if any(s in probe for s in ("_ms", " ms", "ms/", "_s ", "seconds_per",
+                                "sec_per", "s/step", "latency")):
+        return "lower"
+    return "higher"
+
+
+def diff_reports(base: dict[str, Any], new: dict[str, Any],
+                 threshold: float = 0.1) -> dict[str, Any]:
+    """Compare every shared numeric metric of the table; a metric REGRESSES
+    when it moves in its worse direction by more than ``threshold``
+    (relative; a zero baseline uses absolute change).  Returns
+    {regressions, improvements, unchanged, compared, threshold} — plus
+    ``metric_mismatch`` (and NO comparisons) when the two inputs are bench
+    lines for different metrics: a decode line diffed against an attention
+    line would otherwise compare unrelated numbers silently."""
+    m_a, m_b = base.get("metric"), new.get("metric")
+    if m_a is not None and m_b is not None and m_a != m_b:
+        return {"compared": 0, "threshold": threshold,
+                "metric_mismatch": {"base": m_a, "new": m_b},
+                "regressions": [], "improvements": [], "unchanged": []}
+    table = _DIFF_METRICS + (("value", _value_direction(base)),)
+    regressions, improvements, unchanged = [], [], []
+    for key, better in table:
+        a, b = base.get(key), new.get(key)
+        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)) \
+                or isinstance(a, bool) or isinstance(b, bool):
+            continue
+        if not (math.isfinite(a) and math.isfinite(b)):
+            continue
+        delta = (b - a) / abs(a) if a else (b - a)
+        worse = delta > threshold if better == "lower" \
+            else delta < -threshold
+        better_move = delta < -threshold if better == "lower" \
+            else delta > threshold
+        row = {"metric": key, "base": a, "new": b,
+               "delta_frac": round(delta, 6), "better": better}
+        (regressions if worse else
+         improvements if better_move else unchanged).append(row)
+    return {
+        "compared": len(regressions) + len(improvements) + len(unchanged),
+        "threshold": threshold,
+        "regressions": regressions,
+        "improvements": improvements,
+        "unchanged": unchanged,
+    }
+
+
+# ------------------------------------------------------------------- CLI
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributed_tensorflow_tpu.observability.analyze",
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("spans", help="span aggregation + stall summary")
+    sp.add_argument("trace", help="trace JSONL (--trace output)")
+
+    ex = sub.add_parser("export", help="Chrome-trace JSON for Perfetto")
+    ex.add_argument("trace", help="trace JSONL (--trace output)")
+    ex.add_argument("-o", "--output", default=None,
+                    help="output path (default: <trace>.chrome.json)")
+
+    he = sub.add_parser("health", help="health timeline summary")
+    he.add_argument("metrics", help="metrics or trace JSONL")
+    he.add_argument("--max-update-ratio", type=float, default=1.0,
+                    help="update-ratio anomaly ceiling (HealthConfig "
+                         "default; pass the run's value if customized)")
+    he.add_argument("--spike-factor", type=float, default=10.0,
+                    help="loss-spike anomaly factor (HealthConfig default)")
+
+    df = sub.add_parser("diff", help="run-vs-run regression diff "
+                                     "(exit 1 iff a metric regressed)")
+    df.add_argument("base", help="baseline report/summary/bench JSON(L)")
+    df.add_argument("new", help="candidate report/summary/bench JSON(L)")
+    df.add_argument("--threshold", type=float, default=0.1,
+                    help="relative regression threshold (default 0.1)")
+
+    args = p.parse_args(argv)
+    if args.cmd == "spans":
+        print(json.dumps(trace_summary(read_jsonl(args.trace)), indent=2))
+        return 0
+    if args.cmd == "export":
+        out = args.output or str(args.trace) + ".chrome.json"
+        trace = to_chrome_trace(read_jsonl(args.trace))
+        Path(out).write_text(json.dumps(trace))
+        n = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+        print(f"wrote {out}: {len(trace['traceEvents'])} events "
+              f"({n} spans) — load it at https://ui.perfetto.dev",
+              file=sys.stderr)
+        return 0
+    if args.cmd == "health":
+        print(json.dumps(health_timeline(
+            read_jsonl(args.metrics),
+            max_update_ratio=args.max_update_ratio,
+            loss_spike_factor=args.spike_factor), indent=2))
+        return 0
+    # diff: 0 = no regression, 1 = regression past threshold, 2 = nothing
+    # was compared (mismatched bench metrics, or inputs sharing no known
+    # metric keys — e.g. an operator diffing two trace files).  A 0 on an
+    # empty comparison would read as "no regression" for a typo.
+    result = diff_reports(load_report(args.base), load_report(args.new),
+                          threshold=args.threshold)
+    print(json.dumps(result, indent=2))
+    if result.get("metric_mismatch") or result["compared"] == 0:
+        return 2
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,282 @@
+"""Numeric training-health stats, computed ON DEVICE inside the step.
+
+PR 2 built the telemetry transport (sink/trace/report); this module puts a
+numeric-health signal on it.  The old divergence check was host-side and
+loss-only (``utils/failure.check_finite`` at log cadence) — it misses
+exploding grad norms, silent weight blow-ups and per-leaf non-finites until
+the loss is already garbage.  Here every step carries, stacked through the
+``lax.scan`` trajectory exactly like loss/accuracy:
+
+  grad_norm        global L2 of the raw gradient (pre-optimizer)
+  param_norm       global L2 of the parameters entering the update
+  update_norm      global L2 of the applied update (post-optimizer Δp)
+  update_ratio     ‖Δp‖ / ‖p‖ — the classic step-sanity number
+  nonfinite_count  leaves whose post-update params contain NaN/inf
+  loss_spike       loss / bias-corrected running EMA of the loss
+
+The capture rides the OPTIMIZER, not the engines: ``wrap_optimizer`` chains
+two pass-through ``optax`` transforms around the engine's ``tx`` — one
+before it (sees the raw gradients) and one after (sees the final updates
+and the parameters) — whose *states* hold the scalars.  Every engine funnels
+its cross-device-reduced gradients through ``self.tx.update``, so one hook
+covers sync/async/gossip/fsdp/tp/ep/sp/pipeline without touching their step
+programs.  ``Engine.enable_health`` installs the wrap and the base
+``step``/``build_many_step`` hooks read the scalars back out of the NEW
+``opt_state`` inside the jit (``from_opt_state``) and merge them into the
+step metrics.  With health OFF nothing is wrapped and nothing is read — the
+compiled program is byte-for-byte the pre-health one (the same discipline
+as ``--grad-compression none``).
+
+Engines whose state stacks per-device copies (async local SGD, gossip)
+carry the capture scalars with that leading axis; ``from_opt_state``
+reduces them — worst device for the norms/ratio, sum for the non-finite
+count — so the reported stat is the one an operator wants paged about.
+
+``detect_anomalies`` is the host-side policy half: given one step's
+materialized floats and thresholds, it names every offending stat.  The
+Trainer runs it per step at chunk flush (``--on-anomaly warn|halt``),
+subsuming the loss-only nan_guard.
+
+``HealthConfig.inject_nan_at`` is a TEST hook: it scales the gradients of
+one optimizer step by ``inject_scale`` (default inf) inside the capture
+transform, so the detection path is testable end to end on any engine.
+Python-level gated — ``None`` leaves the program untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+# the per-step stats the health layer adds to the metrics trajectory
+HEALTH_KEYS = ("grad_norm", "param_norm", "update_norm", "update_ratio",
+               "nonfinite_count", "loss_spike")
+
+_EPS = 1e-12
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """Thresholds of the anomaly policy + the EMA shape of the spike score.
+
+    Defaults are deliberately loose — they flag pathology (a step that
+    rewrites the whole model, a 10× loss jump, any non-finite), not noisy
+    training.  ``max_grad_norm`` is None (disabled) because a sane ceiling
+    is model-scale-dependent; non-finite grad norms are always flagged.
+    """
+
+    ema_decay: float = 0.9           # loss EMA decay (bias-corrected)
+    loss_spike_factor: float = 10.0  # anomaly: loss > factor × EMA
+    max_update_ratio: float = 1.0    # anomaly: ‖Δp‖/‖p‖ above this
+    max_grad_norm: float | None = None  # anomaly ceiling (None: disabled)
+    # TEST hook: scale the gradients of this 1-based optimizer step by
+    # inject_scale (inf → the seeded-NaN acceptance scenario).  None (the
+    # default) compiles to the unmodified program.
+    inject_nan_at: int | None = None
+    inject_scale: float = float("inf")
+
+
+class GradCaptureState(NamedTuple):
+    """Pre-optimizer capture: raw-gradient norm + optimizer-step count."""
+
+    count: jax.Array      # optimizer updates applied so far (1-based)
+    grad_norm: jax.Array
+
+
+class UpdateCaptureState(NamedTuple):
+    """Post-optimizer capture: parameter/update norms and non-finites."""
+
+    param_norm: jax.Array
+    update_norm: jax.Array
+    update_ratio: jax.Array
+    nonfinite_count: jax.Array
+
+
+def global_norm(tree: Any) -> jax.Array:
+    """Global L2 norm over every leaf, accumulated in f32 (bf16 leaves
+    would overflow their own square sums)."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(jnp.asarray(l, jnp.float32)))
+                        for l in leaves))
+
+
+def nonfinite_leaf_count(tree: Any) -> jax.Array:
+    """Number of floating LEAVES containing any NaN/inf (integer leaves
+    cannot be non-finite and are skipped)."""
+    leaves = [l for l in jax.tree.leaves(tree)
+              if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)]
+    if not leaves:
+        return jnp.zeros((), jnp.int32)
+    return sum(jnp.any(~jnp.isfinite(l)).astype(jnp.int32) for l in leaves)
+
+
+def _grad_capture(config: HealthConfig) -> optax.GradientTransformation:
+    """Pass-through transform BEFORE the optimizer: records the global
+    gradient norm (and applies the test-only NaN injection)."""
+
+    def init(params):
+        del params
+        return GradCaptureState(count=jnp.zeros((), jnp.int32),
+                                grad_norm=jnp.zeros((), jnp.float32))
+
+    def update(updates, state, params=None):
+        del params
+        count = state.count + 1
+        if config.inject_nan_at is not None:  # python gate: test hook only
+            scale = jnp.where(count == config.inject_nan_at,
+                              jnp.float32(config.inject_scale),
+                              jnp.float32(1.0))
+            updates = jax.tree.map(lambda g: g * scale.astype(g.dtype),
+                                   updates)
+        return updates, GradCaptureState(count=count,
+                                         grad_norm=global_norm(updates))
+
+    return optax.GradientTransformation(init, update)
+
+
+def _update_capture() -> optax.GradientTransformation:
+    """Pass-through transform AFTER the optimizer: records ‖p‖, ‖Δp‖,
+    their ratio, and the non-finite leaf count of the post-update params
+    (``apply_updates`` is ``p + Δp``, recomputed here leaf-wise so the
+    count reflects what the next step will train on)."""
+
+    def init(params):
+        del params
+        # distinct arrays per field: donated states must not alias one
+        # zero buffer across leaves (double-donation is a runtime error)
+        return UpdateCaptureState(param_norm=jnp.zeros((), jnp.float32),
+                                  update_norm=jnp.zeros((), jnp.float32),
+                                  update_ratio=jnp.zeros((), jnp.float32),
+                                  nonfinite_count=jnp.zeros((), jnp.int32))
+
+    def update(updates, state, params=None):
+        del state
+        if params is None:
+            raise ValueError(
+                "health capture needs tx.update(grads, opt_state, params) — "
+                "every engine in this repo passes params; a custom caller "
+                "must too")
+        pn = global_norm(params)
+        un = global_norm(updates)
+        new_params = jax.tree.map(lambda p, u: p + u.astype(p.dtype)
+                                  if jnp.issubdtype(jnp.asarray(p).dtype,
+                                                    jnp.floating) else p,
+                                  params, updates)
+        return updates, UpdateCaptureState(
+            param_norm=pn, update_norm=un,
+            update_ratio=un / jnp.maximum(pn, _EPS),
+            nonfinite_count=nonfinite_leaf_count(new_params))
+
+    return optax.GradientTransformation(init, update)
+
+
+def wrap_optimizer(tx: optax.GradientTransformation,
+                   config: HealthConfig) -> optax.GradientTransformation:
+    """``chain(grad_capture, tx, update_capture)`` — the whole install."""
+    return optax.chain(_grad_capture(config), tx, _update_capture())
+
+
+def _find_capture(opt_state: Any, typ: type) -> list:
+    found: list = []
+
+    def visit(x):
+        if isinstance(x, typ):
+            found.append(x)
+        return x
+
+    jax.tree.map(visit, opt_state, is_leaf=lambda x: isinstance(x, typ))
+    return found
+
+
+def from_opt_state(opt_state: Any) -> dict[str, jax.Array]:
+    """Read the captured health scalars back out of a (possibly nested,
+    possibly per-device-stacked) optimizer state.  Norms/ratio reduce with
+    ``max`` (worst device copy is the one to page about), the non-finite
+    count with ``sum``."""
+    grads = _find_capture(opt_state, GradCaptureState)
+    upds = _find_capture(opt_state, UpdateCaptureState)
+    if not grads or not upds:
+        raise ValueError(
+            "no health capture state in opt_state — call "
+            "Engine.enable_health() BEFORE init_state()/the first step, so "
+            "the optimizer tree gains its capture slots")
+    g, u = grads[0], upds[0]
+    return {
+        "grad_norm": jnp.max(g.grad_norm).astype(jnp.float32),
+        "param_norm": jnp.max(u.param_norm).astype(jnp.float32),
+        "update_norm": jnp.max(u.update_norm).astype(jnp.float32),
+        "update_ratio": jnp.max(u.update_ratio).astype(jnp.float32),
+        "nonfinite_count": jnp.sum(u.nonfinite_count).astype(jnp.int32),
+    }
+
+
+# --------------------------------------------------------------- loss EMA
+
+def ema_init() -> tuple[jax.Array, jax.Array]:
+    """(ema_value, step_count) carry — threaded through the scan so the
+    spike score is computed on device, k-invariantly."""
+    return (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32))
+
+
+def ema_spike(loss: jax.Array, ema: tuple[jax.Array, jax.Array],
+              config: HealthConfig):
+    """(spike_score, new_ema): loss over the bias-corrected running EMA of
+    the loss (Adam-style correction, so early steps are not judged against
+    the zero init).  The first step scores 1.0 by definition."""
+    val, t = ema
+    loss32 = jnp.asarray(loss, jnp.float32)
+    decay = jnp.float32(config.ema_decay)
+    corrected = val / jnp.maximum(1.0 - decay ** t.astype(jnp.float32),
+                                  _EPS)
+    spike = jnp.where(t > 0, loss32 / jnp.maximum(corrected, _EPS),
+                      jnp.float32(1.0))
+    new = (decay * val + (1.0 - decay) * loss32, t + 1)
+    return spike, new
+
+
+# --------------------------------------------------------- anomaly policy
+
+def detect_anomalies(floats: dict[str, float],
+                     config: HealthConfig) -> list[dict[str, Any]]:
+    """Host-side policy over ONE step's materialized metrics: returns one
+    record per offending stat — ``{"stat", "value", "limit", "reason",
+    "kind"}`` — empty when the step is healthy.  ``kind`` separates
+    ``'nonfinite'`` (divergence: NaN/inf anywhere — the class the legacy
+    nan_guard made fatal) from ``'threshold'`` (a finite value past its
+    ceiling); the threshold checks only fire on finite values (a NaN
+    comparison would silently pass them)."""
+    out: list[dict[str, Any]] = []
+
+    def flag(stat: str, value, limit, reason: str, kind: str) -> None:
+        out.append({"stat": stat, "value": value, "limit": limit,
+                    "reason": reason, "kind": kind})
+
+    nf = floats.get("nonfinite_count")
+    if nf is not None and nf > 0:
+        flag("nonfinite_count", nf, 0,
+             "non-finite values in the updated parameters", "nonfinite")
+    for stat in ("loss", "grad_norm", "update_ratio", "loss_spike"):
+        v = floats.get(stat)
+        if v is not None and not math.isfinite(v):
+            flag(stat, v, None, "non-finite", "nonfinite")
+    gn = floats.get("grad_norm")
+    if (config.max_grad_norm is not None and gn is not None
+            and math.isfinite(gn) and gn > config.max_grad_norm):
+        flag("grad_norm", gn, config.max_grad_norm,
+             "gradient norm above ceiling", "threshold")
+    ur = floats.get("update_ratio")
+    if ur is not None and math.isfinite(ur) and ur > config.max_update_ratio:
+        flag("update_ratio", ur, config.max_update_ratio,
+             "update rewrote too much of the model in one step", "threshold")
+    ls = floats.get("loss_spike")
+    if ls is not None and math.isfinite(ls) and ls > config.loss_spike_factor:
+        flag("loss_spike", ls, config.loss_spike_factor,
+             "loss spiked vs its running EMA", "threshold")
+    return out

@@ -37,7 +37,11 @@ def build_run_report(fit_result: dict[str, Any], *,
     report: dict[str, Any] = {
         "schema_version": SCHEMA_VERSION,
         "steps": fit_result.get("steps"),
-        "elapsed_s": elapsed or None,
+        # the measured value, even when it is 0.0 (an instantly-ending run
+        # is a real observation); None only when fit never reported one —
+        # `elapsed or None` used to collapse the two
+        "elapsed_s": (float(fit_result["elapsed"])
+                      if fit_result.get("elapsed") is not None else None),
         # resolved drain shape + the chunk lengths actually dispatched,
         # and WHY auto mode downshifted when it did (None: no clamp)
         "steps_per_call": fit_result.get("steps_per_call"),
@@ -73,12 +77,21 @@ def build_run_report(fit_result: dict[str, Any], *,
     report["metrics_sink"] = None if metrics_logger is None else \
         metrics_logger.stats()
 
+    # numeric-health summary (Trainer fit with the engine's health layer
+    # on): anomaly record + run maxima of the per-step stats.  None when
+    # health was off — "disabled" stays distinguishable from "healthy".
+    report["health"] = fit_result.get("health")
+
     overhead = 0.0
     if tracer is not None and tracer.enabled:
         report["spans"] = tracer.span_summary()
         tstats = tracer.stats()
-        report["trace"] = {k: v for k, v in tstats.items()
-                           if k in ("written", "dropped")} or None
+        # an ENABLED tracer always reports a dict — written/dropped are
+        # ints for a file-backed sink (0 = enabled but idle), None for an
+        # aggregate-only tracer (no file).  The old `... or None` collapsed
+        # enabled-but-idle into the same None as disabled.
+        report["trace"] = {"written": tstats.get("written"),
+                           "dropped": tstats.get("dropped")}
         overhead += tracer.overhead_s
     else:
         report["spans"] = None

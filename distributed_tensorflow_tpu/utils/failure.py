@@ -31,6 +31,13 @@ class TrainingDiverged(RuntimeError):
     """Loss/metric became NaN or infinite."""
 
 
+class AnomalyDetected(TrainingDiverged):
+    """The health layer's ``--on-anomaly halt`` policy fired: a per-step
+    health stat (observability/health.py) crossed its threshold or went
+    non-finite.  Subclasses TrainingDiverged so ``run_with_recovery``
+    refuses to restart into the same divergence."""
+
+
 class StallDetected(RuntimeError):
     """No step completed within the watchdog timeout."""
 

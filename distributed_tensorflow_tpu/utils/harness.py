@@ -138,6 +138,19 @@ class ExperimentConfig:
                                            # a wedged XLA runtime is not
                                            # possible)
     nan_guard: bool = True                 # divergence check at log cadence
+                                           # (legacy alias: --health on
+                                           # subsumes it with the per-step
+                                           # anomaly policy)
+    health: str = "off"                    # 'on': per-step numeric-health
+                                           # stats on device inside the
+                                           # scan (observability/health.py)
+                                           # — zero downshift, stacked like
+                                           # metrics; 'off' compiles the
+                                           # exact pre-health program
+    on_anomaly: str = "warn"               # health anomaly policy: 'warn'
+                                           # records structured anomaly
+                                           # events; 'halt' raises at the
+                                           # offending step
     max_restarts: int = 0                  # >0: checkpoint-resume crash
                                            # recovery (run_with_recovery)
     sample_tokens: int = 0                 # >0: after training an LM, decode
@@ -1194,6 +1207,14 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         # on the next invocation with the same cache dir
         enable_compile_cache(config.compile_cache)
     ex = _setup(config)
+    # numeric-health layer: must be enabled BEFORE any state init (the
+    # optimizer tree gains its capture slots at tx.init) — including the
+    # --resume template below
+    if config.health not in ("off", "on"):
+        raise ValueError(
+            f"--health must be 'off' or 'on', got '{config.health}'")
+    if config.health == "on":
+        ex.engine.enable_health()
     n, train_ds, test_ds = ex.n, ex.train_ds, ex.test_ds
     global_batch = ex.global_batch
     if config.sample_tokens:
@@ -1226,7 +1247,24 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                 rng = jax.random.key(config.seed)
                 template = ex.engine.init_state(
                     rng, train_ds.x[: max(1, ex.n)])
-                trainer.state = ckpt_mgr.restore(template)
+                try:
+                    trainer.state = ckpt_mgr.restore(template)
+                except Exception as e:
+                    # the most common structure mismatch here is a --health
+                    # toggle across the resume boundary: enable_health
+                    # grows the optimizer tree by two capture slots, so a
+                    # checkpoint written under the other setting no longer
+                    # matches the template — name that cause instead of
+                    # surfacing the checkpoint library's raw tree error
+                    raise ValueError(
+                        f"--resume could not restore the checkpoint under "
+                        f"{config.checkpoint_dir} into this run's state "
+                        f"layout (--health {config.health}).  If the "
+                        f"checkpointed run used a different --health "
+                        f"setting, the optimizer tree differs (the health "
+                        f"capture slots live in it) — resume with the "
+                        f"original setting.  Original error: "
+                        f"{type(e).__name__}: {e}") from e
                 sink.emit("resumed", step=ckpt_mgr.latest_step())
 
     metrics_logger = None
@@ -1282,6 +1320,7 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                                   metrics_logger=metrics_logger,
                                   watchdog=watchdog,
                                   nan_guard=config.nan_guard,
+                                  on_anomaly=config.on_anomaly,
                                   steps_per_call=config.steps_per_call,
                                   prefetch=config.prefetch,
                                   tracer=tracer)

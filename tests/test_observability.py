@@ -220,7 +220,53 @@ def test_run_report_none_for_absent_subsystems():
     assert report["metrics_sink"] is None
     assert report["prefetch"] is None
     assert report["spans"] is None
+    assert report["trace"] is None
+    assert report["health"] is None
     assert report["telemetry_overhead_frac"] is None
+
+
+def test_run_report_zero_elapsed_is_not_none():
+    """Satellite: a measured 0.0-elapsed run is a real observation — the
+    old `elapsed or None` collapsed it into 'never reported'."""
+    report = build_run_report({"elapsed": 0.0, "steps": 0})
+    assert report["elapsed_s"] == 0.0
+    assert build_run_report({"steps": 0})["elapsed_s"] is None
+
+
+def test_run_report_enabled_idle_tracer_is_not_none(tmp_path):
+    """Satellite: an ENABLED tracer always reports a trace dict —
+    file-backed-but-idle shows integer zeros-or-counts, aggregate-only
+    shows None written/dropped; only a DISABLED tracer reports None."""
+    agg = Tracer(path=None)
+    report = build_run_report(_fit_result(), tracer=agg)
+    assert report["trace"] == {"written": None, "dropped": None}
+    agg.close()
+    with Tracer(path=tmp_path / "t.jsonl") as filed:
+        filed._sink.flush()
+        report = build_run_report(_fit_result(), tracer=filed)
+    assert isinstance(report["trace"]["written"], int)
+    assert report["trace"]["dropped"] == 0
+
+
+def test_run_report_single_chunk_run_has_no_steady_percentiles():
+    """Satellite: a run that never left its compile-smeared first chunk
+    has NO steady state — percentiles report None, compile_s the whole
+    prefix — rather than smearing compile into 'steady' numbers."""
+    st = StepTimer()
+    st.compile_steps = 8
+    st.times = [0.5] * 8  # one chunk, all compile-smeared
+    report = build_run_report(
+        {"elapsed": 4.0, "steps": 8, "step_time": st.summary()})
+    assert report["compile_s"] == pytest.approx(4.0)
+    assert report["step_time_p50_s"] is None
+    assert report["step_time_p95_s"] is None
+    assert report["step_time_mean_s"] is None
+
+
+def test_run_report_without_step_time():
+    report = build_run_report({"elapsed": 1.0, "steps": 0})
+    assert report["compile_s"] is None
+    assert report["step_time_p50_s"] is None
 
 
 # --------------------------------------------------- harness / CLI end-to-end
@@ -241,7 +287,7 @@ def test_cli_run_report_with_telemetry_at_k8(tmp_path):
         [sys.executable, "-m", "distributed_tensorflow_tpu.cli",
          "--dataset", "synthetic", "--model", "mlp", "-n", "1",
          "-b", "32", "--log-every", "4", "--steps-per-call", "8",
-         "--watchdog-timeout", "30",
+         "--watchdog-timeout", "30", "--health", "on",
          "--metrics-path", str(metrics), "--trace", str(trace)],
         capture_output=True, text=True, timeout=300, env=env, cwd=str(repo))
     if proc.returncode != 0 and "shard_map" in (proc.stderr or ""):
@@ -255,9 +301,14 @@ def test_cli_run_report_with_telemetry_at_k8(tmp_path):
     assert report["watchdog"]["beats"] >= 1
     assert report["watchdog"]["timeout_s"] == pytest.approx(240.0)
     assert report["telemetry_overhead_s"] >= 0
+    # --health on: the report carries the health section and the metric
+    # records carry the on-device health trajectory (ISSUE 4)
+    assert report["health"]["anomalies"] == 0
+    assert report["health"]["max_update_ratio"] > 0
     # both artifacts are whole-line JSONL with the schema stamp
     recs = [json.loads(line) for line in metrics.read_text().splitlines()]
     assert recs and all(r["schema_version"] == SCHEMA_VERSION for r in recs)
+    assert all("grad_norm" in r and "update_ratio" in r for r in recs)
     spans = [json.loads(line) for line in trace.read_text().splitlines()]
     assert any(s.get("name") == "compile" for s in spans)
     assert any(s.get("name") == "eval" for s in spans)

@@ -529,7 +529,7 @@ def test_bench_stream_smoke_emits_json():
     env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_PER_CHIP_BATCH="8")
     proc = subprocess.run(
         [sys.executable, str(repo / "bench.py"), "--stream", "--steps", "2",
-         "--no-probe"],
+         "--no-probe", "--health", "on"],
         capture_output=True, text=True, timeout=540, env=env, cwd=str(repo))
     assert proc.returncode == 0, proc.stderr[-2000:]
     payload = json.loads(proc.stdout.strip().splitlines()[-1])
@@ -547,3 +547,7 @@ def test_bench_stream_smoke_emits_json():
         assert payload["step_time_p95"] >= payload["step_time_p50"]
         assert payload["prefetch_starvation"] >= 0
         assert payload["trainer_examples_per_sec"] > 0
+        # --health on riders: the fit result's health summary surfaces on
+        # the bench line (max update ratio + anomaly steps)
+        assert payload["health_max_update_ratio"] > 0
+        assert payload["health_anomaly_steps"] == []
