@@ -43,6 +43,7 @@ Measurement protocol (upgraded round 3 — see BASELINE.md "methodology"):
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import statistics
@@ -200,12 +201,44 @@ def ensure_backend(metric: str) -> None:
     sys.exit(0)
 
 
+@contextlib.contextmanager
+def _bench_checkpointing(fit_kw: dict, checkpoint_every: int):
+    """--checkpoint-every N: arm ``fit_kw`` with an N-step async
+    checkpoint cadence into a throwaway dir, so the Trainer window's JSON
+    line carries the blocked-vs-overlapped seconds split (the durability
+    cost actually charged against throughput).  Teardown (writer join +
+    dir removal) runs even when a benched fit raises — a failed bench
+    must not leak TrainState checkpoints under /tmp or a live writer
+    thread.  No-op when ``checkpoint_every`` is 0."""
+    if not checkpoint_every:
+        yield
+        return
+    import shutil
+    import tempfile
+
+    from distributed_tensorflow_tpu.utils.checkpoint import (
+        AsyncCheckpointManager)
+
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    ckpt_mgr = AsyncCheckpointManager(ckpt_dir)
+    fit_kw.update(checkpoint_manager=ckpt_mgr,
+                  checkpoint_every=checkpoint_every)
+    try:
+        yield
+    finally:
+        # reraise=False: fit's own final drain already surfaced writer
+        # errors on the normal path; the failure path must not mask
+        ckpt_mgr.close(reraise=False)
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
 # ---------------------------------------------------------------------------
 # default mode: training throughput + MFU
 # ---------------------------------------------------------------------------
 
 def bench_throughput(grad_compression: str = "none",
-                     health: str = "off") -> None:
+                     health: str = "off",
+                     checkpoint_every: int = 0) -> None:
     import jax
 
     from distributed_tensorflow_tpu.data.loaders import load_dataset
@@ -295,10 +328,11 @@ def bench_throughput(grad_compression: str = "none",
         trainer.state = state
         fit_kw = dict(epochs=1, batch_size=global_batch, log_every=0,
                       steps_per_call=8, max_steps=dispatch_steps)
-        trainer.fit(ds, **fit_kw)  # warm: compiles the k=8 drain
-        for _ in range(REPEATS):
-            fit = trainer.fit(ds, **fit_kw)
-            dispatch_rates.append(fit["examples"] / fit["elapsed"])
+        with _bench_checkpointing(fit_kw, checkpoint_every):
+            trainer.fit(ds, **fit_kw)  # warm: compiles the k=8 drain
+            for _ in range(REPEATS):
+                fit = trainer.fit(ds, **fit_kw)
+                dispatch_rates.append(fit["examples"] / fit["elapsed"])
         last_fit = fit
         state = trainer.state
 
@@ -367,6 +401,15 @@ def bench_throughput(grad_compression: str = "none",
         "grad_bytes_per_step_wire": eng.grad_collective_bytes(state),
         "grad_bytes_per_step_raw": eng.grad_collective_bytes_raw(state),
         "grad_compression": eng.grad_codec.name,
+        # --checkpoint-every: blocked-vs-overlapped checkpoint seconds of
+        # the Trainer window (async manager; observability/report rule —
+        # only wait_s is charged against throughput)
+        **({"checkpoint_every": checkpoint_every,
+            "checkpoint_wait_s": last_fit.get("checkpoint_wait_s"),
+            "checkpoint_overlapped_s":
+                last_fit.get("checkpoint_overlapped_s"),
+            "checkpoint_async": last_fit.get("checkpoint_async")}
+           if checkpoint_every else {}),
         # numeric-health summary of the Trainer-path window (--health on):
         # the same section the fit result / run report carry
         **({"health_max_update_ratio":
@@ -390,7 +433,7 @@ def bench_throughput(grad_compression: str = "none",
 # ---------------------------------------------------------------------------
 
 def bench_stream(steps: int = 100, grad_compression: str = "none",
-                 health: str = "off") -> None:
+                 health: str = "off", checkpoint_every: int = 0) -> None:
     """Training throughput when every step consumes a FRESH host batch —
     the configuration the C++ prefetcher (native/src/pipeline.cc) exists
     for.  'resident' (one device batch reused, the default bench) bounds the
@@ -480,8 +523,9 @@ def bench_stream(steps: int = 100, grad_compression: str = "none",
     k_fit = 8 if steps > 8 else 1
     fit_kw = dict(epochs=1, batch_size=global_batch, log_every=0,
                   steps_per_call=k_fit, prefetch=2, max_steps=steps)
-    trainer.fit(ds, **fit_kw)  # warm: compiles the drain
-    trainer_fit = trainer.fit(ds, **fit_kw)
+    with _bench_checkpointing(fit_kw, checkpoint_every):
+        trainer.fit(ds, **fit_kw)  # warm: compiles the drain
+        trainer_fit = trainer.fit(ds, **fit_kw)
     state = trainer.state
     fit_st = trainer_fit.get("step_time", {})
 
@@ -519,6 +563,12 @@ def bench_stream(steps: int = 100, grad_compression: str = "none",
         "grad_bytes_per_step_wire": eng.grad_collective_bytes(state),
         "grad_bytes_per_step_raw": eng.grad_collective_bytes_raw(state),
         "grad_compression": eng.grad_codec.name,
+        **({"checkpoint_every": checkpoint_every,
+            "checkpoint_wait_s": trainer_fit.get("checkpoint_wait_s"),
+            "checkpoint_overlapped_s":
+                trainer_fit.get("checkpoint_overlapped_s"),
+            "checkpoint_async": trainer_fit.get("checkpoint_async")}
+           if checkpoint_every else {}),
         **({"health_max_update_ratio":
                 (trainer_fit.get("health") or {}).get("max_update_ratio"),
             "health_anomaly_steps":
@@ -947,6 +997,13 @@ def main() -> None:
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent XLA compilation cache dir — repeat "
                         "bench invocations skip the warmup recompiles")
+    p.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
+                   help="default/--stream: run the Trainer-path window "
+                        "with an N-step async checkpoint cadence into a "
+                        "throwaway dir and report the blocked-vs-"
+                        "overlapped seconds split (checkpoint_wait_s / "
+                        "checkpoint_overlapped_s — the durability cost "
+                        "actually charged against throughput)")
     p.add_argument("--health", default="off", choices=["off", "on"],
                    help="numeric-health layer for the default/--stream "
                         "training benches (observability/health.py): the "
@@ -969,7 +1026,8 @@ def main() -> None:
         if mode == "stream":
             bench_stream(steps=max(args.steps, 1),
                          grad_compression=args.grad_compression,
-                         health=args.health)
+                         health=args.health,
+                         checkpoint_every=args.checkpoint_every)
         elif mode == "attention":
             bench_attention()
         elif mode == "lm":
@@ -980,7 +1038,8 @@ def main() -> None:
             bench_decode()
         else:
             bench_throughput(grad_compression=args.grad_compression,
-                             health=args.health)
+                             health=args.health,
+                             checkpoint_every=args.checkpoint_every)
     except Exception as e:  # noqa: BLE001 — the artifact must stay parsable
         import traceback
         tb = traceback.format_exc()

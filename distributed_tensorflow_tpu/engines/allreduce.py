@@ -46,12 +46,18 @@ class Trainer:
             steps_per_call: int | None, *,
             metrics_logger=None, watchdog=None,
             target_accuracy: float | None = None,
-            checkpoint_every: int = 0) -> tuple[int, str | None]:
+            checkpoint_every: int = 0,
+            checkpoint_async: bool = False) -> tuple[int, str | None]:
         """(k, clamp_reason) — ``resolve_steps_per_call`` plus WHY auto
-        mode downshifted ('target_accuracy' | 'checkpoint_every' | None).
-        The reason comes from the SAME branch that picked k, so the run
-        report's clamp attribution cannot desync from the resolution
-        rules."""
+        mode downshifted ('target_accuracy' | 'checkpoint_sync' |
+        'checkpoint_async' | None).  The reason comes from the SAME branch
+        that picked k, so the run report's clamp attribution cannot desync
+        from the resolution rules.  The two checkpoint reasons share one
+        rule (the crash-loss window is a durability promise either way)
+        but are reported distinctly: a synchronous sub-chunk cadence also
+        costs a blocking save per chunk — worth a warning — while an
+        overlapped save costs only a snapshot, so the async label tells
+        the report reader the clamp is cadence-only, not a stall."""
         del metrics_logger, watchdog  # telemetry rides the chunked drain
         if steps_per_call is not None:
             if steps_per_call < 1:
@@ -61,7 +67,8 @@ class Trainer:
         if target_accuracy is not None:
             return 1, "target_accuracy"
         if 0 < checkpoint_every < DEFAULT_STEPS_PER_CALL:
-            return checkpoint_every, "checkpoint_every"
+            return checkpoint_every, ("checkpoint_async" if checkpoint_async
+                                      else "checkpoint_sync")
         return DEFAULT_STEPS_PER_CALL, None
 
     @staticmethod
@@ -139,7 +146,14 @@ class Trainer:
         ``tracer``: an observability.Tracer — spans ``compile`` /
         ``chunk_dispatch`` / ``materialize`` / ``checkpoint`` / ``eval``
         plus prefetch queue-depth gauges at chunk boundaries; defaults to
-        the inert NULL_TRACER.
+        the inert NULL_TRACER.  An async checkpoint manager
+        (utils/checkpoint.AsyncCheckpointManager) replaces the blocking
+        ``checkpoint`` span with ``ckpt_snapshot`` (training-thread
+        blocked time: previous-write backpressure + device snapshot) and
+        ``ckpt_write`` (the background Orbax write, emitted by the writer
+        thread) — the fit result then splits the cost as
+        ``checkpoint_wait_s`` (charged against throughput) vs
+        ``checkpoint_overlapped_s`` (hidden behind training).
         ``max_steps``: hard step cap across epochs.  ``target_accuracy``
         (with ``eval_ds``): early-stop when test accuracy reaches the
         target — evaluated every ``eval_every`` steps far from the target
@@ -282,20 +296,67 @@ class Trainer:
         # instead of restarting at 1
         # (.reshape(-1)[0]: async engine's step is per-device, one per shard)
         start_step = int(np.asarray(jax.device_get(self.state.step)).reshape(-1)[0])
+        # async checkpoint discipline (utils/checkpoint.py
+        # AsyncCheckpointManager): saves cost the training thread a device
+        # snapshot; the write overlaps the next chunks on a background
+        # writer.  The manager's writer emits ckpt_write spans through the
+        # fit tracer so the timeline shows blocked vs overlapped time.
+        ckpt_async = bool(getattr(checkpoint_manager, "asynchronous", False))
+        if ckpt_async:
+            checkpoint_manager.tracer = tracer
+        ckpt_wait = 0.0  # training-thread seconds spent in checkpointing
+        ckpt_last_step = None  # skip a final save the cadence already wrote
+        # managers outlive fits (bench reuses one): report THIS fit's
+        # overlapped seconds, not the manager's lifetime total
+        ckpt_overlap0 = getattr(checkpoint_manager, "overlapped_s", 0.0)
+
+        def do_checkpoint(step: int, final: bool = False) -> None:
+            """One boundary checkpoint, both disciplines: sync blocks for
+            the full write under a ``checkpoint`` span; async pays only
+            the snapshot (+ any previous-write backpressure) under
+            ``ckpt_snapshot`` — the final save additionally drains, so fit
+            never returns with a write in flight."""
+            nonlocal ckpt_wait, ckpt_last_step
+            t0 = time.perf_counter()
+            # the final boundary often IS the last cadence boundary (steps
+            # divisible by checkpoint_every): that state is already saved
+            # — or in flight — so re-writing it would only re-pay the full
+            # write; the final call then just drains
+            skip_write = final and step == ckpt_last_step
+            # the boundary step is known here — passing it spares save()
+            # its state.step device sync on the training thread
+            if ckpt_async:
+                attrs = {"step": step, **({"final": True} if final else {})}
+                with tracer.span("ckpt_snapshot", **attrs):
+                    if not skip_write:
+                        checkpoint_manager.save(self.state, step=step)
+                    if final:
+                        checkpoint_manager.wait()
+            elif not skip_write:
+                with tracer.span("checkpoint", step=step,
+                                 **({"final": True} if final else {})):
+                    jax.block_until_ready(self.state)
+                    checkpoint_manager.save(self.state, step=step)
+            ckpt_last_step = step
+            ckpt_wait += time.perf_counter() - t0
+
         k, clamp_reason = self.resolve_steps_per_call_with_reason(
             steps_per_call, metrics_logger=metrics_logger, watchdog=watchdog,
             target_accuracy=target_accuracy,
             checkpoint_every=(checkpoint_every
-                              if checkpoint_manager is not None else 0))
+                              if checkpoint_manager is not None else 0),
+            checkpoint_async=ckpt_async)
         # surface auto-mode downshifts (the run report carries the reason,
-        # attributed by the resolver itself; checkpoint clamps additionally
-        # warn — an explicit steps_per_call is never clamped, checkpoints
-        # then land on chunk boundaries)
+        # attributed by the resolver itself; SYNC checkpoint clamps
+        # additionally warn — the shortened chunk also costs a blocking
+        # save per chunk, whereas an async clamp is cadence-only — and an
+        # explicit steps_per_call is never clamped, checkpoints then land
+        # on chunk boundaries)
         spc_clamp = None
         if clamp_reason is not None:
             spc_clamp = {"requested": DEFAULT_STEPS_PER_CALL,
                          "effective": k, "reason": clamp_reason}
-            if clamp_reason == "checkpoint_every":
+            if clamp_reason == "checkpoint_sync":
                 import warnings
 
                 warnings.warn(
@@ -303,10 +364,12 @@ class Trainer:
                     f"steady-state drain at steps_per_call={k} (auto "
                     f"default {DEFAULT_STEPS_PER_CALL}): state exists only "
                     f"at chunk boundaries, so the requested crash-loss "
-                    f"window shortens the chunk.  Pass an explicit "
+                    f"window shortens the chunk — and each boundary pays a "
+                    f"blocking synchronous save.  Pass an explicit "
                     f"--steps-per-call to keep longer chunks (checkpoints "
                     f"then land on the first boundary at/after each due "
-                    f"step).", stacklevel=2)
+                    f"step), or use the async checkpoint manager to take "
+                    f"the save off the critical path.", stacklevel=2)
         if watchdog is not None:
             # one beat per host sync = one beat per chunk: the per-step
             # stall budget becomes a per-beat budget of k × timeout, so
@@ -400,211 +463,229 @@ class Trainer:
                 log_fn(f"step {gstep}  loss {m['loss']:.4f}"
                        f"  acc {m['accuracy']:.4f}")
 
-        for epoch in range(epochs):
-            if stop:
-                break
-            pf = DevicePrefetch(
-                train_ds.batches(local_bs, shuffle=True, seed=self.seed,
-                                 epoch=epoch, drop_remainder=True),
-                place, depth=prefetch)
-            try:
-                if k == 1:
-                    for xs, ys in pf:
-                        chunk_sizes.add(1)  # per ACTUAL dispatch: a
-                        # zero-batch epoch must not report a chunk shape
-                        with timer:  # amortized dispatch+throttle time
-                            if not compiled:
-                                # first dispatch traces+compiles the step
-                                # synchronously — span it under the name
-                                # the run report splits out
-                                with tracer.span("compile", steps=1):
+        # A failed fit (AnomalyDetected halt, divergence, watchdog abort
+        # path, a raising engine) must not leak background work: the
+        # prefetcher is closed by its per-epoch finally below, and the
+        # except block drains the async checkpoint writer and flushes the
+        # telemetry sinks before the error propagates — no writer thread
+        # or half-buffered JSONL record outlives the fit.  The cleanup
+        # never masks the original error: the drain runs reraise=False
+        # and the flushes swallow their own failures.
+        try:
+            for epoch in range(epochs):
+                if stop:
+                    break
+                pf = DevicePrefetch(
+                    train_ds.batches(local_bs, shuffle=True, seed=self.seed,
+                                     epoch=epoch, drop_remainder=True),
+                    place, depth=prefetch)
+                try:
+                    if k == 1:
+                        for xs, ys in pf:
+                            chunk_sizes.add(1)  # per ACTUAL dispatch: a
+                            # zero-batch epoch must not report a chunk shape
+                            with timer:  # amortized dispatch+throttle time
+                                if not compiled:
+                                    # first dispatch traces+compiles the step
+                                    # synchronously — span it under the name
+                                    # the run report splits out
+                                    with tracer.span("compile", steps=1):
+                                        self.state, metrics = eng.step(
+                                            self.state, xs, ys)
+                                    compiled = True
+                                else:
                                     self.state, metrics = eng.step(
                                         self.state, xs, ys)
-                                compiled = True
-                            else:
-                                self.state, metrics = eng.step(
-                                    self.state, xs, ys)
-                            in_flight.append(metrics)
-                            if len(in_flight) > self.max_in_flight:
-                                jax.block_until_ready(in_flight.pop(0))
-                        if watchdog is not None:
-                            # beat AFTER dispatch+throttle: the first beat
-                            # arms the clock past the first-step XLA compile,
-                            # and throttling bounds how far this loop runs
-                            # ahead of the device, so a hung collective stops
-                            # the beats within the window
-                            watchdog.beat()
-                        steps += 1
-                        gstep = start_step + steps
-                        examples += bs  # global examples per step
-                        dev_metrics = metrics
-                        if health_cfg is not None:
-                            # the anomaly policy needs this step's values:
-                            # materialize now (per-step sync — the honest
-                            # cost of step-exact detection at k=1; the
-                            # chunked drain pays one sync per chunk)
-                            floats = {kk: float(v)
-                                      for kk, v in dev_metrics.items()}
-                            record_step(gstep, lambda f=floats: f)
-                            note_health(gstep, floats)
-                        else:
-                            record_step(gstep, lambda: {
-                                kk: float(v) for kk, v in dev_metrics.items()})
-                        if checkpoint_manager is not None and \
-                                checkpoint_every and \
-                                gstep % checkpoint_every == 0:
-                            with tracer.span("checkpoint", step=gstep):
-                                jax.block_until_ready(self.state)
-                                checkpoint_manager.save(self.state)
-                        at_cap = max_steps is not None and steps >= max_steps
-                        if eval_and_maybe_stop(steps - 1, at_cap):
-                            break
-                        if at_cap:
-                            stop = True
-                            break
-                else:
-                    # chunk-level in-flight window — the chunk rendering of
-                    # the k==1 path's max_in_flight throttle: without
-                    # chunk-boundary STATE consumers (periodic checkpoints,
-                    # target eval — which auto mode downshifts for anyway)
-                    # up to max_in_flight dispatched chunks stay
-                    # unmaterialized, so a slow host↔device link (tunnel
-                    # RTT) is paid once per window, not per chunk, and the
-                    # device always has queued work.  With state consumers,
-                    # window 0: every chunk flushes eagerly at its boundary
-                    # so checkpoint/eval see exactly the boundary state.
-                    window = (self.max_in_flight
-                              if checkpoint_manager is None
-                              and target_accuracy is None else 0)
-                    in_flight_chunks: list = []  # (n_steps, t_disp, stacked)
-                    t_mark = 0.0  # end of the previous flush (timing ref)
-
-                    def flush_chunk():
-                        """Materialize the oldest dispatched chunk — ONE
-                        host sync for its (k,)-stacked per-step trajectory —
-                        and run its per-step bookkeeping."""
-                        nonlocal steps, examples, metrics, last_metrics, \
-                            t_mark
-                        n_chunk, t_disp, stacked = in_flight_chunks.pop(0)
-                        with tracer.span("materialize", steps=n_chunk):
-                            floats = {kk: np.asarray(jax.device_get(v))
-                                      for kk, v in stacked.items()}
-                        # chunk boundary: prefetch queue-depth/starvation
-                        # gauges ride the same host sync
-                        tracer.gauge("prefetch_depth", pf.queue_depth,
-                                     starvation=pf.starvation)
-                        now = time.perf_counter()
-                        # per-step wall time as the chunk average over the
-                        # non-overlapped span (the first chunk smears its
-                        # XLA compile over its k entries)
-                        dt = (now - max(t_disp, t_mark)) / n_chunk
-                        t_mark = now
-                        timer.times.extend([dt] * n_chunk)
-                        if watchdog is not None:
-                            # flush beat: real device progress confirmed
-                            # (the stall budget is k × per-step timeout —
-                            # Watchdog.rescale above)
-                            watchdog.beat()
-                        for i in range(n_chunk):
+                                in_flight.append(metrics)
+                                if len(in_flight) > self.max_in_flight:
+                                    jax.block_until_ready(in_flight.pop(0))
+                            if watchdog is not None:
+                                # beat AFTER dispatch+throttle: the first beat
+                                # arms the clock past the first-step XLA compile,
+                                # and throttling bounds how far this loop runs
+                                # ahead of the device, so a hung collective stops
+                                # the beats within the window
+                                watchdog.beat()
                             steps += 1
                             gstep = start_step + steps
                             examples += bs  # global examples per step
-                            m = {kk: float(v[i]) for kk, v in floats.items()}
-                            metrics = m
-                            record_step(gstep, lambda m=m: m)
+                            dev_metrics = metrics
                             if health_cfg is not None:
-                                note_health(gstep, m)
-
-                    dispatched = steps
-                    next_chunk = pf.take(k if max_steps is None
-                                         else min(k, max_steps - dispatched))
-                    while not stop and next_chunk:
-                        chunk = next_chunk
-                        t_disp = time.perf_counter()
-                        span_name = "chunk_dispatch" if compiled \
-                            else "compile"
-                        with tracer.span(span_name, steps=len(chunk)):
-                            self.state, stacked = eng.many_step(
-                                self.state, [c[0] for c in chunk],
-                                [c[1] for c in chunk])
-                        if not compiled:
-                            # the first chunk smears its XLA compile over
-                            # its k per-step time entries — tell the timer
-                            # where steady state starts
-                            timer.compile_steps = len(chunk)
-                            compiled = True
-                        if watchdog is not None:
-                            # beat at dispatch too, not only at flush: the
-                            # first dispatch's synchronous trace+compile is
-                            # behind us here, so this arms the clock BEFORE
-                            # the first flush — a device that hangs inside
-                            # the first window would otherwise never arm an
-                            # arm_on_first_beat watchdog (dispatches are
-                            # bounded by the in-flight window, so a hung
-                            # device still stops the beats within it)
-                            watchdog.beat()
-                        chunk_sizes.add(len(chunk))
-                        dispatched += len(chunk)
-                        in_flight_chunks.append((len(chunk), t_disp, stacked))
-                        # assemble chunk N+1 while the device runs chunk N
-                        # (dispatch above is async): host batch prep
-                        # overlaps device compute
-                        nxt = k if max_steps is None else min(
-                            k, max_steps - dispatched)
-                        next_chunk = pf.take(nxt) if nxt > 0 else []
-                        while len(in_flight_chunks) > window:
-                            chunk_start = steps
-                            flush_chunk()
-                            if window:
-                                continue
-                            # eager boundary: state consumers run with
-                            # self.state == the just-flushed boundary state
+                                # the anomaly policy needs this step's values:
+                                # materialize now (per-step sync — the honest
+                                # cost of step-exact detection at k=1; the
+                                # chunked drain pays one sync per chunk)
+                                floats = {kk: float(v)
+                                          for kk, v in dev_metrics.items()}
+                                record_step(gstep, lambda f=floats: f)
+                                note_health(gstep, floats)
+                            else:
+                                record_step(gstep, lambda: {
+                                    kk: float(v) for kk, v in dev_metrics.items()})
                             if checkpoint_manager is not None and \
                                     checkpoint_every and \
-                                    (start_step + steps) // checkpoint_every \
-                                    > (start_step + chunk_start) // checkpoint_every:
-                                # first chunk boundary at/after the due step
-                                with tracer.span("checkpoint",
-                                                 step=start_step + steps):
-                                    jax.block_until_ready(self.state)
-                                    checkpoint_manager.save(self.state)
-                            at_cap = (max_steps is not None
-                                      and steps >= max_steps)
-                            # evaluated at chunk boundaries (auto mode runs
-                            # k=1 under target_accuracy, so boundary == step)
-                            if eval_and_maybe_stop(chunk_start, at_cap):
+                                    gstep % checkpoint_every == 0:
+                                do_checkpoint(gstep)
+                            at_cap = max_steps is not None and steps >= max_steps
+                            if eval_and_maybe_stop(steps - 1, at_cap):
                                 break
-                    # epoch end (or early stop): drain the window in order
-                    while in_flight_chunks:
-                        flush_chunk()
-                    if max_steps is not None and steps >= max_steps:
-                        stop = True
-            finally:
-                # the prefetcher read ahead of the consumer: release the
-                # source (a native batcher's busy claim) deterministically,
-                # folding its gauges into the run totals first
-                pf_starvation += pf.starvation
-                pf_fill_wait += pf.fill_wait_s
-                pf.close()
-        if (target_accuracy is not None and eval_ds is not None
-                and not reached and steps and prev_eval_step != steps):
-            # loop ended by exhausting epochs (not the cap): still finish
-            # with a real eval so eval_accuracy is never stale/uncomputed
-            eval_gap = steps - prev_eval_step
-            eval_acc = self.evaluate(eval_ds, batch_size=eval_batch)["accuracy"]
-            reached = eval_acc >= target_accuracy
-            if not reached:
-                eval_gap = None
-        jax.block_until_ready(self.state)
-        if nan_guard and steps:
-            final = {k: float(v) for k, v in metrics.items()}
-            check_finite(final, start_step + steps)
-            last_metrics = last_metrics or final
-        elapsed = time.perf_counter() - t0
-        if checkpoint_manager is not None:
-            with tracer.span("checkpoint", step=start_step + steps,
-                             final=True):
-                checkpoint_manager.save(self.state)
+                            if at_cap:
+                                stop = True
+                                break
+                    else:
+                        # chunk-level in-flight window — the chunk rendering of
+                        # the k==1 path's max_in_flight throttle: without
+                        # chunk-boundary STATE consumers (periodic checkpoints,
+                        # target eval — which auto mode downshifts for anyway)
+                        # up to max_in_flight dispatched chunks stay
+                        # unmaterialized, so a slow host↔device link (tunnel
+                        # RTT) is paid once per window, not per chunk, and the
+                        # device always has queued work.  With state consumers,
+                        # window 0: every chunk flushes eagerly at its boundary
+                        # so checkpoint/eval see exactly the boundary state.
+                        window = (self.max_in_flight
+                                  if checkpoint_manager is None
+                                  and target_accuracy is None else 0)
+                        in_flight_chunks: list = []  # (n_steps, t_disp, stacked)
+                        t_mark = 0.0  # end of the previous flush (timing ref)
+
+                        def flush_chunk():
+                            """Materialize the oldest dispatched chunk — ONE
+                            host sync for its (k,)-stacked per-step trajectory —
+                            and run its per-step bookkeeping."""
+                            nonlocal steps, examples, metrics, last_metrics, \
+                                t_mark
+                            n_chunk, t_disp, stacked = in_flight_chunks.pop(0)
+                            with tracer.span("materialize", steps=n_chunk):
+                                floats = {kk: np.asarray(jax.device_get(v))
+                                          for kk, v in stacked.items()}
+                            # chunk boundary: prefetch queue-depth/starvation
+                            # gauges ride the same host sync
+                            tracer.gauge("prefetch_depth", pf.queue_depth,
+                                         starvation=pf.starvation)
+                            now = time.perf_counter()
+                            # per-step wall time as the chunk average over the
+                            # non-overlapped span (the first chunk smears its
+                            # XLA compile over its k entries)
+                            dt = (now - max(t_disp, t_mark)) / n_chunk
+                            t_mark = now
+                            timer.times.extend([dt] * n_chunk)
+                            if watchdog is not None:
+                                # flush beat: real device progress confirmed
+                                # (the stall budget is k × per-step timeout —
+                                # Watchdog.rescale above)
+                                watchdog.beat()
+                            for i in range(n_chunk):
+                                steps += 1
+                                gstep = start_step + steps
+                                examples += bs  # global examples per step
+                                m = {kk: float(v[i]) for kk, v in floats.items()}
+                                metrics = m
+                                record_step(gstep, lambda m=m: m)
+                                if health_cfg is not None:
+                                    note_health(gstep, m)
+
+                        dispatched = steps
+                        next_chunk = pf.take(k if max_steps is None
+                                             else min(k, max_steps - dispatched))
+                        while not stop and next_chunk:
+                            chunk = next_chunk
+                            t_disp = time.perf_counter()
+                            span_name = "chunk_dispatch" if compiled \
+                                else "compile"
+                            with tracer.span(span_name, steps=len(chunk)):
+                                self.state, stacked = eng.many_step(
+                                    self.state, [c[0] for c in chunk],
+                                    [c[1] for c in chunk])
+                            if not compiled:
+                                # the first chunk smears its XLA compile over
+                                # its k per-step time entries — tell the timer
+                                # where steady state starts
+                                timer.compile_steps = len(chunk)
+                                compiled = True
+                            if watchdog is not None:
+                                # beat at dispatch too, not only at flush: the
+                                # first dispatch's synchronous trace+compile is
+                                # behind us here, so this arms the clock BEFORE
+                                # the first flush — a device that hangs inside
+                                # the first window would otherwise never arm an
+                                # arm_on_first_beat watchdog (dispatches are
+                                # bounded by the in-flight window, so a hung
+                                # device still stops the beats within it)
+                                watchdog.beat()
+                            chunk_sizes.add(len(chunk))
+                            dispatched += len(chunk)
+                            in_flight_chunks.append((len(chunk), t_disp, stacked))
+                            # assemble chunk N+1 while the device runs chunk N
+                            # (dispatch above is async): host batch prep
+                            # overlaps device compute
+                            nxt = k if max_steps is None else min(
+                                k, max_steps - dispatched)
+                            next_chunk = pf.take(nxt) if nxt > 0 else []
+                            while len(in_flight_chunks) > window:
+                                chunk_start = steps
+                                flush_chunk()
+                                if window:
+                                    continue
+                                # eager boundary: state consumers run with
+                                # self.state == the just-flushed boundary state
+                                if checkpoint_manager is not None and \
+                                        checkpoint_every and \
+                                        (start_step + steps) // checkpoint_every \
+                                        > (start_step + chunk_start) // checkpoint_every:
+                                    # first chunk boundary at/after the due step
+                                    do_checkpoint(start_step + steps)
+                                at_cap = (max_steps is not None
+                                          and steps >= max_steps)
+                                # evaluated at chunk boundaries (auto mode runs
+                                # k=1 under target_accuracy, so boundary == step)
+                                if eval_and_maybe_stop(chunk_start, at_cap):
+                                    break
+                        # epoch end (or early stop): drain the window in order
+                        while in_flight_chunks:
+                            flush_chunk()
+                        if max_steps is not None and steps >= max_steps:
+                            stop = True
+                finally:
+                    # the prefetcher read ahead of the consumer: release the
+                    # source (a native batcher's busy claim) deterministically,
+                    # folding its gauges into the run totals first
+                    pf_starvation += pf.starvation
+                    pf_fill_wait += pf.fill_wait_s
+                    pf.close()
+            if (target_accuracy is not None and eval_ds is not None
+                    and not reached and steps and prev_eval_step != steps):
+                # loop ended by exhausting epochs (not the cap): still finish
+                # with a real eval so eval_accuracy is never stale/uncomputed
+                eval_gap = steps - prev_eval_step
+                eval_acc = self.evaluate(eval_ds, batch_size=eval_batch)["accuracy"]
+                reached = eval_acc >= target_accuracy
+                if not reached:
+                    eval_gap = None
+            jax.block_until_ready(self.state)
+            if nan_guard and steps:
+                final = {k: float(v) for k, v in metrics.items()}
+                check_finite(final, start_step + steps)
+                last_metrics = last_metrics or final
+            elapsed = time.perf_counter() - t0
+            if checkpoint_manager is not None:
+                # final=True drains the async writer too: fit never returns
+                # (or hands state to a resume) with a write still in flight
+                do_checkpoint(start_step + steps, final=True)
+        except BaseException:
+            if checkpoint_manager is not None:
+                try:
+                    checkpoint_manager.wait(reraise=False)
+                except Exception:
+                    pass
+            for _sink in (metrics_logger, tracer):
+                _flush = getattr(_sink, "flush", None)
+                if _flush is not None:
+                    try:
+                        _flush()
+                    except Exception:
+                        pass
+            raise
         result = {
             "elapsed": elapsed, "steps": steps, "epochs": epochs,
             # resolved drain shape (tests/tools read these back: auto mode
@@ -620,6 +701,17 @@ class Trainer:
             **({"grad_allreduce_bytes": grad_bytes,
                 "grad_allreduce_bytes_raw": grad_bytes_raw,
                 "grad_compression": grad_codec} if grad_bytes else {}),
+            # checkpoint cost accounting (MLPerf-style: blocked time is
+            # charged against throughput, overlapped time is not):
+            # checkpoint_wait_s = training-thread seconds inside save/
+            # drain calls; checkpoint_overlapped_s = background-writer
+            # seconds that ran concurrently with training (0.0 sync)
+            **({"checkpoint_wait_s": ckpt_wait,
+                "checkpoint_overlapped_s": (
+                    getattr(checkpoint_manager, "overlapped_s", 0.0)
+                    - ckpt_overlap0),
+                "checkpoint_async": ckpt_async}
+               if checkpoint_manager is not None else {}),
             **({"steps_per_call_clamp": spc_clamp} if spc_clamp else {}),
             **({"watchdog_beats": watchdog.beats,
                 "watchdog_stalls": watchdog.stall_episodes}

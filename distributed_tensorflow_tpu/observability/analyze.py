@@ -92,6 +92,16 @@ def trace_summary(records: list[dict]) -> dict[str, Any]:
                       and r.get("name") == "chunk_dispatch")
     gaps = [max(b[0] - (a[0] + a[1]), 0.0)
             for a, b in zip(dispatch, dispatch[1:])]
+    # checkpoint time split: 'checkpoint' (sync save) and 'ckpt_snapshot'
+    # (async backpressure + device snapshot) block the training thread;
+    # 'ckpt_write' is the background writer's Orbax write.  NB these are
+    # span WALL times — the run report's checkpoint_overlapped_s
+    # additionally discounts write seconds the trainer stood blocked on
+    # (they live in checkpoint_wait_s), so blocked_s + the report's
+    # overlapped_s ≈ the span totals here, never more
+    ckpt_blocked = sum(spans.get(n, {}).get("total_s", 0.0)
+                       for n in ("checkpoint", "ckpt_snapshot"))
+    ckpt_overlapped = spans.get("ckpt_write", {}).get("total_s", 0.0)
     return {
         "records": len(records),
         "spans": spans,
@@ -104,6 +114,8 @@ def trace_summary(records: list[dict]) -> dict[str, Any]:
                                      if not g.get("value")),
             "gauges": len(gauges),
             "max_dispatch_gap_s": max(gaps) if gaps else None,
+            "checkpoint_blocked_s": ckpt_blocked,
+            "checkpoint_overlapped_s": ckpt_overlapped,
             "anomaly_events": len(anomalies),
             "first_anomaly_step": (anomalies[0].get("step")
                                    if anomalies else None),
@@ -245,6 +257,10 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     ("step_time_mean_s", "lower"), ("compile_s", "lower"),
     ("elapsed_s", "lower"), ("telemetry_overhead_frac", "lower"),
     ("grad_allreduce_bytes", "lower"),
+    # training-thread seconds blocked on checkpointing (run report /
+    # fit result; overlapped_s is deliberately NOT compared — moving work
+    # onto the background writer is the point, not a regression)
+    ("checkpoint_wait_s", "lower"),
     ("examples_per_sec", "higher"), ("examples_per_sec_per_device", "higher"),
     ("test_accuracy", "higher"),
     # bench.py line vocabulary ("value"'s direction is resolved per line —

@@ -59,6 +59,13 @@ def build_run_report(fit_result: dict[str, Any], *,
         "step_time_p50_s": st.get("steady_p50_s"),
         "step_time_p95_s": st.get("steady_p95_s"),
         "step_time_mean_s": st.get("steady_mean_s"),
+        # checkpoint cost split (BASELINE.md accounting rule): wait_s is
+        # training-thread blocked time — the only part charged against
+        # throughput — overlapped_s ran on the background writer behind
+        # training.  None when the run had no checkpoint manager.
+        "checkpoint_wait_s": fit_result.get("checkpoint_wait_s"),
+        "checkpoint_overlapped_s": fit_result.get("checkpoint_overlapped_s"),
+        "checkpoint_async": fit_result.get("checkpoint_async"),
     }
 
     report["watchdog"] = None if watchdog is None else {

@@ -73,6 +73,9 @@ class _NullTracer:
     def stats(self) -> dict:
         return {}
 
+    def flush(self) -> None:
+        pass
+
     def close(self) -> None:
         pass
 
@@ -181,6 +184,13 @@ class Tracer:
         if self._sink is not None:
             out.update(self._sink.stats())
         return out
+
+    def flush(self) -> None:
+        """Drain the queued records to disk without closing the sink —
+        the Trainer's failure-path cleanup calls this so no buffered span
+        outlives a raising fit."""
+        if self._sink is not None:
+            self._sink.flush()
 
     def close(self) -> None:
         if self._sink is not None:

@@ -119,6 +119,12 @@ class ExperimentConfig:
     pipeline_hidden: int = 128             # pipeline stage width
     checkpoint_dir: str | None = None      # enable TrainState checkpointing
     checkpoint_every: int = 0              # steps between checkpoints (0=end only)
+    async_checkpoint: bool = True          # overlap checkpoint writes with
+                                           # training (AsyncCheckpointManager:
+                                           # device snapshot on the training
+                                           # thread, Orbax write + retention
+                                           # on a background writer); False =
+                                           # the synchronous blocking save
     resume: bool = False                   # restore latest checkpoint first
     metrics_path: str | None = None        # per-step metrics JSONL (async
                                            # crash-durable sink; rides the
@@ -1236,9 +1242,18 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         raise ValueError("--checkpoint-every requires --checkpoint-dir "
                          "(no checkpoints would be written otherwise)")
     if config.checkpoint_dir:
-        from distributed_tensorflow_tpu.utils.checkpoint import CheckpointManager
+        from distributed_tensorflow_tpu.utils.checkpoint import (
+            AsyncCheckpointManager, CheckpointManager)
 
-        ckpt_mgr = CheckpointManager(config.checkpoint_dir)
+        # async (the default) takes the Orbax write off the training
+        # thread; --async-checkpoint off restores the synchronous
+        # blocking-save path bit-for-bit (same on-disk format either way).
+        # Constructing EITHER manager sweeps any tmp_step_* left by a
+        # crashed write, so --resume below only ever sees complete
+        # (renamed) checkpoints.
+        ckpt_mgr = (AsyncCheckpointManager(config.checkpoint_dir)
+                    if config.async_checkpoint
+                    else CheckpointManager(config.checkpoint_dir))
         if config.resume:
             if ckpt_mgr.latest_step() is None:
                 print(f"warning: --resume set but no checkpoint found under "
@@ -1396,6 +1411,13 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         sink.emit("summary", **summary)
         return summary
     finally:
+        if ckpt_mgr is not None:
+            # drain + join the checkpoint writer on ANY exit: a restart
+            # (run_with_recovery) must never begin its restore with a
+            # previous run's write still in flight.  reraise=False — the
+            # normal path already surfaced writer errors at fit's final
+            # drain, and the exception path must not mask its error.
+            ckpt_mgr.close(reraise=False)
         if metrics_logger is not None:
             metrics_logger.close()  # drain + flush the async JSONL sink
         tracer.close()

@@ -1,0 +1,500 @@
+"""Async checkpointing (ISSUE 5): checkpoint + eval I/O off the training
+critical path.
+
+Contracts covered, per the issue's checklist:
+
+* async-vs-sync bitwise equality — a state saved under both disciplines
+  restores tree-equal (params / opt_state / step / rng);
+* atomicity under an injected writer crash — no visible ``step_N``
+  directory is ever half-written (the crash leaves only ``tmp_step_N``,
+  swept on the next manager start), and the error re-raises on the
+  training thread at the next save / wait / close;
+* in-flight-save backpressure — a second save WAITS on the previous
+  write (bounding host memory to one extra TrainState), never drops;
+* the acceptance bound — with a deliberately slowed writer, the training
+  thread's ``checkpoint_wait_s`` under async mode is < 25% of the same
+  run's synchronous save time, while the restored states stay tree-equal;
+* failure-path cleanup — a fit that raises mid-run leaves no background
+  writer in flight and no half-buffered JSONL records.
+
+Everything here runs on any jax (the Trainer paths go through the
+pure-jit ``JitEngine``; harness runs use the GSPMD fsdp engine — neither
+needs ``jax.shard_map``).
+"""
+
+import dataclasses
+import json
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from test_steady_state import JitEngine, _tiny_ds  # noqa: E402
+
+from distributed_tensorflow_tpu.engines.allreduce import Trainer
+from distributed_tensorflow_tpu.utils.checkpoint import (
+    AsyncCheckpointError, AsyncCheckpointManager, CheckpointManager)
+
+
+def _as_np(v):
+    if hasattr(v, "dtype") and jax.dtypes.issubdtype(
+            v.dtype, jax.dtypes.prng_key):
+        v = jax.random.key_data(v)
+    return np.asarray(jax.device_get(v))
+
+
+def assert_states_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(_as_np(x), _as_np(y))
+
+
+def _trained_state(n_steps=2, seed=0):
+    eng = JitEngine()
+    ds = _tiny_ds(128)
+    state = eng.init_state(jax.random.key(seed), ds.x[:8])
+    xs, ys = eng.shard_batch(ds.x[:32], ds.y[:32])
+    for _ in range(n_steps):
+        state, _ = eng.step(state, xs, ys)
+    jax.block_until_ready(state)
+    return eng, ds, state
+
+
+def _template(seed=0):
+    eng = JitEngine()
+    ds = _tiny_ds(128)
+    return eng.init_state(jax.random.key(seed), ds.x[:8])
+
+
+class SlowWriter(AsyncCheckpointManager):
+    """Writer-delay test shim: every background write sleeps ``delay``
+    first; write number ``crash_at`` (1-based) instead leaves a partial
+    ``tmp_step_N`` behind and raises — a fault-injected mid-write crash.
+    ``fake=True`` replaces the real Orbax write with a marker directory:
+    the write cost becomes EXACTLY ``delay`` (the real write's duration
+    jitters with GIL contention from the training thread), which is what
+    the timing-ratio acceptance test needs to be deterministic."""
+
+    def __init__(self, *args, delay=0.0, crash_at=None, fake=False, **kw):
+        self.delay = delay
+        self.crash_at = crash_at
+        self.fake = fake
+        self.writes = 0
+        super().__init__(*args, **kw)
+
+    def _write(self, step, host_state):
+        self.writes += 1
+        time.sleep(self.delay)
+        if self.crash_at is not None and self.writes == self.crash_at:
+            tmp = self.directory / f"tmp_step_{step}"
+            tmp.mkdir(exist_ok=True)
+            (tmp / "partial.bin").write_text("torn")
+            raise RuntimeError("injected writer crash")
+        if self.fake:
+            (self.directory / f"step_{step}").mkdir(exist_ok=True)
+            return
+        super()._write(step, host_state)
+
+
+class SlowSyncWriter(CheckpointManager):
+    """The synchronous counterpart of :class:`SlowWriter` — same write
+    delay, paid on the training thread (the acceptance comparison's
+    baseline)."""
+
+    def __init__(self, *args, delay=0.0, fake=False, **kw):
+        self.delay = delay
+        self.fake = fake
+        super().__init__(*args, **kw)
+
+    def _write(self, step, host_state):
+        time.sleep(self.delay)
+        if self.fake:
+            (self.directory / f"step_{step}").mkdir(exist_ok=True)
+            return
+        super()._write(step, host_state)
+
+
+class _SlowBatchDataset:
+    """Wraps a Dataset so every produced batch costs ``sleep_s`` of host
+    time — simulated between-checkpoint compute for the writer to overlap."""
+
+    def __init__(self, ds, sleep_s):
+        self._ds = ds
+        self.sleep_s = sleep_s
+
+    def __getattr__(self, name):
+        return getattr(self._ds, name)
+
+    def __len__(self):
+        return len(self._ds)
+
+    def batches(self, *args, **kw):
+        for b in self._ds.batches(*args, **kw):
+            time.sleep(self.sleep_s)
+            yield b
+
+
+# ------------------------------------------------------- manager semantics
+
+def test_async_sync_checkpoints_bitwise_equal(tmp_path):
+    """The same state saved under both disciplines restores tree-equal —
+    the async snapshot/transfer/write chain loses nothing."""
+    eng, ds, state = _trained_state()
+    sync_mgr = CheckpointManager(tmp_path / "sync")
+    async_mgr = AsyncCheckpointManager(tmp_path / "async")
+    sync_mgr.save(state)
+    async_mgr.save(state)
+    async_mgr.wait()
+    assert sync_mgr.steps() == async_mgr.steps() == [2]
+
+    a = async_mgr.restore(_template())
+    s = sync_mgr.restore(_template())
+    assert_states_equal(a, s)
+    assert_states_equal(a, state)
+    async_mgr.close()
+
+
+def test_async_save_survives_donated_buffers(tmp_path):
+    """The snapshot decouples the save from the live buffers: training
+    steps dispatched IMMEDIATELY after save (donating the state the
+    writer is still reading) must not corrupt the checkpoint."""
+    eng, ds, state = _trained_state()
+    expect = jax.device_get(jax.tree.map(lambda x: x, state.params))
+    mgr = SlowWriter(tmp_path / "c", delay=0.2)
+    mgr.save(state)
+    xs, ys = eng.shard_batch(ds.x[:32], ds.y[:32])
+    for _ in range(3):  # donates/overwrites the saved buffers mid-write
+        state, _ = eng.step(state, xs, ys)
+    jax.block_until_ready(state)
+    mgr.wait()
+    restored = mgr.restore(_template(), step=2)
+    jax.tree.map(lambda e, r: np.testing.assert_array_equal(e, _as_np(r)),
+                 expect, restored.params)
+    mgr.close()
+
+
+def test_backpressure_second_save_waits_never_drops(tmp_path):
+    """At most one save in flight: save N+1 blocks until write N lands —
+    both checkpoints exist afterwards, and the blocked time is visible in
+    wait_s."""
+    _eng, _ds, state = _trained_state()
+    mgr = SlowWriter(tmp_path / "c", delay=0.3, max_to_keep=10)
+    t0 = time.perf_counter()
+    mgr.save(state, step=1)
+    first_save_s = time.perf_counter() - t0
+    assert first_save_s < 0.25  # did NOT pay the write on this thread
+    t0 = time.perf_counter()
+    mgr.save(state, step=2)  # must wait out write #1 (~0.3s)
+    assert time.perf_counter() - t0 > 0.2
+    assert 1 in mgr.steps()  # write #1 landed before save #2 proceeded
+    mgr.wait()
+    assert mgr.steps() == [1, 2]  # never dropped
+    assert mgr.saves == 2
+    assert mgr.wait_s > 0.2
+    # wall time the trainer stood blocked on a write is charged to wait_s
+    # ONLY — overlapped_s keeps just the genuinely concurrent share
+    # (here: nearly everything was blocked, so overlap stays small)
+    assert mgr.overlapped_s < 0.3
+    mgr.close()
+
+
+def test_writer_crash_leaves_no_visible_partial(tmp_path):
+    """Fault injection: a mid-write crash must leave only ``tmp_step_N``
+    (invisible to steps()/restore), re-raise on the training thread at
+    the next checkpoint, and be swept by the next manager start."""
+    _eng, _ds, state = _trained_state()
+    mgr = SlowWriter(tmp_path / "c", delay=0.0, crash_at=1)
+    mgr.save(state, step=5)
+    mgr._idle.wait()  # let the writer fail without consuming the error
+    assert mgr.steps() == []                       # nothing visible
+    assert (mgr.directory / "tmp_step_5").exists()  # only the torn tmp
+    with pytest.raises(AsyncCheckpointError, match="injected writer crash"):
+        mgr.save(state, step=6)  # the NEXT checkpoint surfaces the error
+    mgr.wait()  # save 6 was never enqueued (the raise aborted it)
+    assert mgr.steps() == []
+    mgr.close()
+
+    fresh = AsyncCheckpointManager(tmp_path / "c")  # next start sweeps tmp
+    assert not (fresh.directory / "tmp_step_5").exists()
+    assert fresh.latest_step() is None
+    fresh.close()
+
+
+def test_writer_error_reraises_at_close(tmp_path):
+    _eng, _ds, state = _trained_state()
+    mgr = SlowWriter(tmp_path / "c", crash_at=1)
+    mgr.save(state, step=1)
+    with pytest.raises(AsyncCheckpointError):
+        mgr.close()
+    # reraise=False (the exception-path cleanup contract) must swallow
+    mgr2 = SlowWriter(tmp_path / "c2", crash_at=1)
+    mgr2.save(state, step=1)
+    mgr2.close(reraise=False)
+
+
+def test_sync_write_is_atomic_too(tmp_path):
+    """The tmp-fsync-rename discipline is shared: a synchronous write that
+    crashes leaves only the tmp directory."""
+    _eng, _ds, state = _trained_state()
+
+    class CrashingSync(CheckpointManager):
+        def _write(self, step, host_state):
+            tmp = self.directory / f"tmp_step_{step}"
+            tmp.mkdir(exist_ok=True)
+            (tmp / "partial.bin").write_text("torn")
+            raise RuntimeError("boom")
+
+    mgr = CrashingSync(tmp_path / "c")
+    with pytest.raises(RuntimeError, match="boom"):
+        mgr.save(state, step=3)
+    assert mgr.steps() == []
+    assert (mgr.directory / "tmp_step_3").exists()
+
+
+def test_restore_drains_pending_write(tmp_path):
+    """The resume barrier: restore blocks on an in-flight write, so it
+    always reads the newest complete checkpoint."""
+    _eng, _ds, state = _trained_state()
+    mgr = SlowWriter(tmp_path / "c", delay=0.3)
+    mgr.save(state, step=1)  # still writing when restore is called
+    restored = mgr.restore(_template())  # waits, then reads step_1
+    assert_states_equal(restored, state)
+    mgr.close()
+
+
+# ------------------------------------------------------- trainer wiring
+
+def test_fit_async_spans_and_result_keys(tmp_path):
+    """Async fit: ckpt_snapshot (training thread) + ckpt_write (writer
+    thread) spans land in the trace, and the result carries the
+    blocked/overlapped split the run report reads."""
+    from distributed_tensorflow_tpu.observability import (
+        Tracer, build_run_report)
+    from distributed_tensorflow_tpu.observability.analyze import trace_summary
+
+    mgr = AsyncCheckpointManager(tmp_path / "c", max_to_keep=10)
+    tr = Trainer(None, engine=JitEngine(), seed=0)
+    trace = tmp_path / "t.jsonl"
+    tracer = Tracer(path=trace)
+    r = tr.fit(_tiny_ds(256), epochs=1, batch_size=16, log_every=0,
+               steps_per_call=4, checkpoint_manager=mgr,
+               checkpoint_every=4, max_steps=12, tracer=tracer)
+    mgr.close()
+    tracer.close()
+    assert r["checkpoint_async"] is True
+    assert r["checkpoint_wait_s"] >= 0.0
+    assert r["checkpoint_overlapped_s"] >= 0.0
+    assert {4, 8, 12} <= set(mgr.steps())
+    report = build_run_report(r)
+    assert report["checkpoint_wait_s"] == r["checkpoint_wait_s"]
+    assert report["checkpoint_overlapped_s"] == r["checkpoint_overlapped_s"]
+    assert report["checkpoint_async"] is True
+
+    recs = [json.loads(l) for l in trace.read_text().splitlines()]
+    names = {x["name"] for x in recs if x.get("event") == "span"}
+    assert "ckpt_snapshot" in names and "ckpt_write" in names
+    assert "checkpoint" not in names  # the blocking span is the sync one
+    summary = trace_summary(recs)
+    assert summary["stalls"]["checkpoint_overlapped_s"] > 0.0
+    assert summary["stalls"]["checkpoint_blocked_s"] >= 0.0
+
+
+def test_fit_sync_keeps_checkpoint_span(tmp_path):
+    from distributed_tensorflow_tpu.observability import Tracer
+
+    mgr = CheckpointManager(tmp_path / "c", max_to_keep=10)
+    tr = Trainer(None, engine=JitEngine(), seed=0)
+    trace = tmp_path / "t.jsonl"
+    tracer = Tracer(path=trace)
+    r = tr.fit(_tiny_ds(256), epochs=1, batch_size=16, log_every=0,
+               steps_per_call=4, checkpoint_manager=mgr,
+               checkpoint_every=4, max_steps=8, tracer=tracer)
+    tracer.close()
+    assert r["checkpoint_async"] is False
+    assert r["checkpoint_overlapped_s"] == 0.0
+    assert r["checkpoint_wait_s"] > 0.0
+    names = {x["name"] for x in
+             (json.loads(l) for l in trace.read_text().splitlines())
+             if x.get("event") == "span"}
+    assert "checkpoint" in names
+    assert "ckpt_snapshot" not in names and "ckpt_write" not in names
+
+
+def test_fit_async_trajectory_matches_sync(tmp_path):
+    """Same seed, both disciplines: final params and every checkpoint are
+    bitwise identical — async changes WHEN the write happens, never what
+    is written or trained."""
+    results = {}
+    for name, mgr in (
+            ("sync", CheckpointManager(tmp_path / "s", max_to_keep=10)),
+            ("async", AsyncCheckpointManager(tmp_path / "a", max_to_keep=10))):
+        tr = Trainer(None, engine=JitEngine(), seed=0)
+        tr.fit(_tiny_ds(256), epochs=1, batch_size=16, log_every=0,
+               checkpoint_manager=mgr, checkpoint_every=4, max_steps=12)
+        mgr.close()
+        results[name] = (tr.state, mgr)
+    assert_states_equal(results["sync"][0], results["async"][0])
+    s_mgr, a_mgr = results["sync"][1], results["async"][1]
+    assert s_mgr.steps() == a_mgr.steps()
+    for step in s_mgr.steps():
+        assert_states_equal(s_mgr.restore(_template(), step=step),
+                            a_mgr.restore(_template(), step=step))
+
+
+def test_acceptance_async_wait_under_quarter_of_sync(tmp_path):
+    """ISSUE 5 acceptance: with a deliberately slowed writer,
+    ``checkpoint_wait_s`` under async mode is < 25% of the same run's
+    synchronous save time.  The write is a pure ``delay`` sleep
+    (``fake=True``) so the ratio is deterministic — the tree-equality
+    half of the acceptance (restored async state == synchronous
+    checkpoint, bitwise, through real Orbax writes) is
+    ``test_fit_async_trajectory_matches_sync`` above."""
+    delay, gap, steps = 0.3, 0.45, 8
+    # more batches than steps: the prefetcher (depth 2) must keep paying
+    # the per-batch gap through the LAST save too — an exhausted source
+    # would hand out its final staged batches gap-free and the tail saves
+    # would block on the still-running previous write
+    ds = _SlowBatchDataset(_tiny_ds(16 * (steps + 4)), gap)
+    # warm the snapshot's on-device-copy compile outside the timed runs
+    # (one-time cost, not steady-state blocked time)
+    from distributed_tensorflow_tpu.utils import checkpoint as ckpt_mod
+
+    jax.block_until_ready(ckpt_mod._snapshot(_template()))
+    waits = {}
+    for name, mgr in (
+            ("sync", SlowSyncWriter(tmp_path / "s", delay=delay,
+                                    fake=True, max_to_keep=20)),
+            ("async", SlowWriter(tmp_path / "a", delay=delay,
+                                 fake=True, max_to_keep=20))):
+        tr = Trainer(None, engine=JitEngine(), seed=0)
+        r = tr.fit(ds, epochs=1, batch_size=16, log_every=0,
+                   checkpoint_manager=mgr, checkpoint_every=1,
+                   max_steps=steps)
+        mgr.close()
+        assert r["steps"] == steps
+        assert mgr.steps() == list(range(1, steps + 1))  # none dropped
+        waits[name] = r["checkpoint_wait_s"]
+        if name == "async":
+            # the gaps genuinely hid several full writes behind training
+            # (discounted accounting: blocked time never counts as overlap)
+            assert r["checkpoint_overlapped_s"] > delay, r
+    # every between-checkpoint gap (0.45s of host batch time) exceeds the
+    # write (0.3s), so the async run's only irreducible blocked time is
+    # the end-of-fit drain of the final save — expected ratio ~1/steps,
+    # asserted at the issue's 25% bound
+    assert waits["sync"] > steps * delay * 0.9  # sanity: sync paid all
+    assert waits["async"] < 0.25 * waits["sync"], waits
+
+
+def test_fit_failure_drains_writer_and_flushes_sinks(tmp_path):
+    """Satellite: a fit that raises mid-run must leave no write in flight
+    and no buffered JSONL records — the failure-path cleanup runs before
+    the error propagates, without masking it."""
+    from distributed_tensorflow_tpu.observability import Tracer
+    from distributed_tensorflow_tpu.utils.metrics import MetricsLogger
+
+    mgr = SlowWriter(tmp_path / "c", delay=0.3, max_to_keep=10)
+    ml = MetricsLogger(tmp_path / "m.jsonl", log_every=1)
+    tracer = Tracer(path=tmp_path / "t.jsonl")
+    tr = Trainer(None, engine=JitEngine(), seed=0)
+
+    def boom(msg):
+        raise RuntimeError("mid-run failure")
+
+    with pytest.raises(RuntimeError, match="mid-run failure"):
+        # log_fn fires at step 2 with a save from step 1 still in flight
+        tr.fit(_tiny_ds(256), epochs=1, batch_size=16, log_every=2,
+               log_fn=boom, checkpoint_manager=mgr, checkpoint_every=1,
+               metrics_logger=ml, tracer=tracer, max_steps=6)
+    assert mgr._idle.is_set()  # writer drained before the raise escaped
+    # the flushed streams are whole-line parsable, records present
+    recs = [json.loads(l)
+            for l in (tmp_path / "m.jsonl").read_text().splitlines()]
+    # record_step logs BEFORE the heartbeat that raised, so the failing
+    # step's own record reaches the (flushed) sink — [1, 2], whole lines
+    assert [r["step"] for r in recs] == [1, 2]
+    for line in (tmp_path / "t.jsonl").read_text().splitlines():
+        json.loads(line)
+    ml.close()
+    tracer.close()
+    mgr.close()
+
+
+def test_fit_failure_cleanup_does_not_mask_error(tmp_path):
+    """A writer crash pending at failure-cleanup time must not replace
+    the fit's own error (the drain runs reraise=False)."""
+    mgr = SlowWriter(tmp_path / "c", delay=0.05, crash_at=1, max_to_keep=10)
+    tr = Trainer(None, engine=JitEngine(), seed=0)
+
+    def boom(msg):
+        raise RuntimeError("the real failure")
+
+    with pytest.raises(RuntimeError, match="the real failure"):
+        tr.fit(_tiny_ds(256), epochs=1, batch_size=16, log_every=2,
+               log_fn=boom, checkpoint_manager=mgr, checkpoint_every=1,
+               max_steps=6)
+    mgr.close(reraise=False)
+
+
+# ------------------------------------------------------- harness / CLI
+
+def test_cli_async_checkpoint_flag_parses():
+    from distributed_tensorflow_tpu.cli import build_parser
+
+    p = build_parser()
+    assert p.parse_args([]).async_checkpoint == "on"  # default on
+    assert p.parse_args(["--async-checkpoint", "off"]).async_checkpoint \
+        == "off"
+    with pytest.raises(SystemExit):
+        p.parse_args(["--async-checkpoint", "maybe"])
+
+
+def test_harness_async_checkpoint_resume_roundtrip(tmp_path):
+    """`--checkpoint-every` + `--resume` under the async default (fsdp
+    engine — GSPMD, runs on any jax): the resumed run continues the
+    original step numbering, and the run report carries the wait split."""
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    common = dict(engine="fsdp", model="mlp", dataset="synthetic",
+                  n_devices=8, batch_size=8, epochs=1, log_every=0,
+                  checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2)
+    first = run(ExperimentConfig(**common))
+    mgr = CheckpointManager(common["checkpoint_dir"])
+    assert mgr.latest_step() == first["steps"]
+    report = first["run_report"]
+    assert report["checkpoint_async"] is True
+    assert report["checkpoint_wait_s"] >= 0.0
+    assert report["checkpoint_overlapped_s"] >= 0.0
+    second = run(ExperimentConfig(**common, resume=True))
+    assert np.isfinite(second["test_loss"])
+    assert mgr.latest_step() == 2 * first["steps"]
+
+
+def test_harness_async_checkpoint_off_is_sync(tmp_path):
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    summary = run(ExperimentConfig(
+        engine="fsdp", model="mlp", dataset="synthetic", n_devices=8,
+        batch_size=8, epochs=1, log_every=0, async_checkpoint=False,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2))
+    report = summary["run_report"]
+    assert report["checkpoint_async"] is False
+    assert report["checkpoint_overlapped_s"] == 0.0
+    assert CheckpointManager(str(tmp_path / "ck")).latest_step() \
+        == summary["steps"]
+
+
+def test_analyze_diff_compares_checkpoint_wait(tmp_path):
+    """`analyze diff` gates on checkpoint_wait_s (lower-better): a slower
+    candidate regresses, a faster one improves."""
+    from distributed_tensorflow_tpu.observability.analyze import diff_reports
+
+    base = {"checkpoint_wait_s": 1.0}
+    worse = diff_reports(base, {"checkpoint_wait_s": 2.0})
+    assert [r["metric"] for r in worse["regressions"]] \
+        == ["checkpoint_wait_s"]
+    better = diff_reports(base, {"checkpoint_wait_s": 0.1})
+    assert [r["metric"] for r in better["improvements"]] \
+        == ["checkpoint_wait_s"]

@@ -368,7 +368,11 @@ def test_resolve_steps_per_call_with_reason():
     resolve = Trainer.resolve_steps_per_call_with_reason
     assert resolve(None) == (8, None)
     assert resolve(None, target_accuracy=0.9) == (1, "target_accuracy")
-    assert resolve(None, checkpoint_every=3) == (3, "checkpoint_every")
+    # the checkpoint clamp rule is shared, but the reason distinguishes
+    # the blocking-save discipline from the overlapped one (ISSUE 5)
+    assert resolve(None, checkpoint_every=3) == (3, "checkpoint_sync")
+    assert resolve(None, checkpoint_every=3, checkpoint_async=True) == \
+        (3, "checkpoint_async")
     assert resolve(None, checkpoint_every=50) == (8, None)
     assert resolve(4, checkpoint_every=3) == (4, None)  # explicit: no clamp
     with pytest.raises(ValueError):
@@ -418,9 +422,9 @@ def test_checkpoint_clamp_warns_and_lands_in_report(mesh8, tmp_path):
                    checkpoint_manager=cm, checkpoint_every=3, max_steps=6)
     assert r["steps_per_call"] == 3
     assert r["steps_per_call_clamp"] == {
-        "requested": 8, "effective": 3, "reason": "checkpoint_every"}
+        "requested": 8, "effective": 3, "reason": "checkpoint_sync"}
     assert build_run_report(r)["steps_per_call_clamp"]["reason"] == \
-        "checkpoint_every"
+        "checkpoint_sync"
 
 
 def test_explicit_steps_per_call_never_warns(mesh8, tmp_path):

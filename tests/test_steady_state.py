@@ -529,7 +529,7 @@ def test_bench_stream_smoke_emits_json():
     env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_PER_CHIP_BATCH="8")
     proc = subprocess.run(
         [sys.executable, str(repo / "bench.py"), "--stream", "--steps", "2",
-         "--no-probe", "--health", "on"],
+         "--no-probe", "--health", "on", "--checkpoint-every", "1"],
         capture_output=True, text=True, timeout=540, env=env, cwd=str(repo))
     assert proc.returncode == 0, proc.stderr[-2000:]
     payload = json.loads(proc.stdout.strip().splitlines()[-1])
@@ -551,3 +551,9 @@ def test_bench_stream_smoke_emits_json():
         # the bench line (max update ratio + anomaly steps)
         assert payload["health_max_update_ratio"] > 0
         assert payload["health_anomaly_steps"] == []
+        # --checkpoint-every riders: the blocked-vs-overlapped checkpoint
+        # seconds split of the async-checkpointed Trainer window
+        assert payload["checkpoint_every"] == 1
+        assert payload["checkpoint_async"] is True
+        assert payload["checkpoint_wait_s"] >= 0
+        assert payload["checkpoint_overlapped_s"] >= 0
