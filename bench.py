@@ -183,6 +183,63 @@ def emit_skip(metric: str, error: str) -> None:
     }))
 
 
+# In-process backend-init retry (satellite of ISSUE 6): the subprocess
+# probe above proves the backend CAN come up, but the bench's own first
+# device touch (mesh creation, first compile) can still lose a transiently
+# wedged lease — r03 died exactly there and r04/r05 were skipped, a 3-round
+# measurement blackout.  Bounded retry-with-backoff around the init block,
+# then PARTIAL-RESULTS emission (see measure_windows) so whatever windows
+# completed are recorded even when a later one dies.
+INIT_RETRIES = int(os.environ.get("BENCH_INIT_RETRIES", "3"))
+INIT_BACKOFF_S = float(os.environ.get("BENCH_INIT_BACKOFF_S", "10"))
+
+
+def with_backend_retry(fn, what: str = "backend init", *,
+                       retries: int | None = None,
+                       backoff_s: float | None = None,
+                       sleep=time.sleep, log=None):
+    """Run ``fn()`` with bounded retry-with-backoff (linear: backoff ×
+    attempt).  Raises the LAST error when every attempt fails — main()'s
+    guard then emits the structured skip line.  ``sleep``/``log`` are
+    injectable for the unit tests that fake the init failure."""
+    retries = INIT_RETRIES if retries is None else retries
+    backoff_s = INIT_BACKOFF_S if backoff_s is None else backoff_s
+    if log is None:
+        log = lambda msg: print(msg, file=sys.stderr, flush=True)  # noqa: E731
+    last: Exception | None = None
+    for attempt in range(max(retries, 1)):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — wedged leases raise anything
+            last = e
+            if attempt + 1 < max(retries, 1):
+                delay = backoff_s * (attempt + 1)
+                log(f"[bench] {what} attempt {attempt + 1}/{retries} "
+                    f"failed: {type(e).__name__}: {e}; retrying in "
+                    f"{delay:g}s")
+                sleep(delay)
+    raise last
+
+
+def measure_windows(fn, repeats: int, label: str,
+                    errors: list[str]) -> list:
+    """Collect up to ``repeats`` measurement windows, KEEPING what
+    completed when one dies (the partial-results mode): the first failing
+    window appends its error to ``errors`` and stops the loop — the
+    caller medians the completed values and emits the line with a
+    ``partial`` section instead of discarding the whole run.  ``fn(rep)``
+    returns one window's value."""
+    vals: list = []
+    for rep in range(repeats):
+        try:
+            vals.append(fn(rep))
+        except Exception as e:  # noqa: BLE001 — record, keep what we have
+            errors.append(f"{label} window {rep + 1}/{repeats}: "
+                          f"{type(e).__name__}: {e}")
+            break
+    return vals
+
+
 def ensure_backend(metric: str) -> None:
     """Bounded retry-with-backoff around backend acquisition; on final
     failure, emit the skip line and exit 0 (see module docstring)."""
@@ -238,7 +295,8 @@ def _bench_checkpointing(fit_kw: dict, checkpoint_every: int):
 
 def bench_throughput(grad_compression: str = "none",
                      health: str = "off",
-                     checkpoint_every: int = 0) -> None:
+                     checkpoint_every: int = 0,
+                     grad_bucket_mb: float = 0.0) -> None:
     import jax
 
     from distributed_tensorflow_tpu.data.loaders import load_dataset
@@ -246,10 +304,15 @@ def bench_throughput(grad_compression: str = "none",
     from distributed_tensorflow_tpu.models import create_model
     from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
-    mesh = meshlib.create_mesh()
+    # the first real device touch — where a transiently wedged lease
+    # (r03) dies even after the subprocess probe passed; bounded retries
+    def _acquire():
+        mesh = meshlib.create_mesh()
+        return mesh, jax.devices()[0].device_kind
+
+    mesh, device_kind = with_backend_retry(_acquire)
     n = mesh.shape[meshlib.DATA_AXIS]
     global_batch = PER_CHIP_BATCH * n
-    device_kind = jax.devices()[0].device_kind
 
     ds = load_dataset("mnist", split="train")
     # measured f32 here: for this small CNN (1 input channel, 28×28) the
@@ -257,7 +320,8 @@ def bench_throughput(grad_compression: str = "none",
     # on v5e.  bf16 mixed precision remains available via --dtype bfloat16
     # and wins on transformer-scale matmuls (see tests/test_models.py).
     model = create_model("cnn", num_classes=ds.num_classes)
-    eng = SyncEngine(model, mesh=mesh, grad_compression=grad_compression)
+    eng = SyncEngine(model, mesh=mesh, grad_compression=grad_compression,
+                     grad_bucket_mb=grad_bucket_mb)
     if health == "on":
         # before init_state: the optimizer tree gains its capture slots
         eng.enable_health()
@@ -266,12 +330,34 @@ def bench_throughput(grad_compression: str = "none",
     idx = rng.integers(0, len(ds.x), global_batch)
     x, y = ds.x[idx], ds.y[idx]
 
-    state = eng.init_state(jax.random.key(0), x[:n])
     xs, ys = eng.shard_batch(x, y)
 
-    for _ in range(WARMUP_STEPS):
-        state, m = eng.step(state, xs, ys)
-    _sync(state)
+    def _warm():
+        # self-contained (state re-inited per attempt): a half-failed
+        # warmup may have consumed its donated state buffers
+        st = eng.init_state(jax.random.key(0), x[:n])
+        for _ in range(WARMUP_STEPS):
+            st, _m = eng.step(st, xs, ys)
+        _sync(st)
+        return st
+
+    # first compile also goes through the retry: a lease that wedges
+    # between probe and compile is the other r03 failure shape
+    state = with_backend_retry(_warm, "first compile/warmup")
+
+    # exposed-vs-hidden collective split (parallel/overlap.py): the
+    # engine's real step vs a collective-free twin vs the exchange alone
+    # — grad_collective_exposed_s is the number `analyze diff` gates
+    # lower-is-better (BASELINE.md).  Probe failure only Nones the keys.
+    overlap_probe = None
+    try:
+        from distributed_tensorflow_tpu.parallel import overlap as overlaplib
+
+        overlap_probe = overlaplib.probe_engine_overlap(
+            eng, xs, ys, state=state)
+    except Exception as e:  # noqa: BLE001 — the probe must not kill the bench
+        print(f"[bench] overlap probe failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
 
     # device-bound windows THROUGH THE PRODUCTION PATH: the scan unit is
     # Engine.build_many_step — the same jitted lax.scan drain
@@ -304,12 +390,26 @@ def bench_throughput(grad_compression: str = "none",
         _sync(st)
         return st, time.perf_counter() - t0
 
-    scan_rates = []
-    for _ in range(REPEATS):
-        state, t_short = window(1, state)
-        state, t_long = window(calls_long, state)
+    # partial-results mode: a window that dies mid-run (lease wedge,
+    # OOM-adjacent flake) records its error and the completed windows
+    # still produce the line — never again an all-or-nothing artifact
+    partial_errors: list[str] = []
+    state_box = [state]
+
+    def _scan_window(_rep):
+        st, t_short = window(1, state_box[0])
+        st, t_long = window(calls_long, st)
+        state_box[0] = st
         per_step = (t_long - t_short) / ((calls_long - 1) * unit_len)
-        scan_rates.append(global_batch / per_step)
+        return global_batch / per_step
+
+    scan_rates = measure_windows(_scan_window, REPEATS, "scan",
+                                 partial_errors)
+    if not scan_rates:
+        # nothing completed: fall through to the structured-skip path
+        raise RuntimeError(f"no scan window completed: "
+                           f"{partial_errors[-1]}")
+    state = state_box[0]
 
     # steady-state rate of the SHIPPED Trainer.fit loop (device prefetch +
     # steps_per_call=8 drain, fresh host batches) — reported as
@@ -328,12 +428,23 @@ def bench_throughput(grad_compression: str = "none",
         trainer.state = state
         fit_kw = dict(epochs=1, batch_size=global_batch, log_every=0,
                       steps_per_call=8, max_steps=dispatch_steps)
+        fit_box: dict = {}
+
+        def _dispatch_window(_rep):
+            fit = trainer.fit(ds, **fit_kw)
+            fit_box["fit"] = fit
+            return fit["examples"] / fit["elapsed"]
+
         with _bench_checkpointing(fit_kw, checkpoint_every):
-            trainer.fit(ds, **fit_kw)  # warm: compiles the k=8 drain
-            for _ in range(REPEATS):
-                fit = trainer.fit(ds, **fit_kw)
-                dispatch_rates.append(fit["examples"] / fit["elapsed"])
-        last_fit = fit
+            try:
+                trainer.fit(ds, **fit_kw)  # warm: compiles the k=8 drain
+            except Exception as e:  # noqa: BLE001 — scan row still emits
+                partial_errors.append(f"dispatch warmup: "
+                                      f"{type(e).__name__}: {e}")
+            else:
+                dispatch_rates = measure_windows(
+                    _dispatch_window, REPEATS, "dispatch", partial_errors)
+        last_fit = fit_box.get("fit", {})
         state = trainer.state
 
     scan_med, scan_spread = _median_spread(scan_rates)
@@ -401,6 +512,14 @@ def bench_throughput(grad_compression: str = "none",
         "grad_bytes_per_step_wire": eng.grad_collective_bytes(state),
         "grad_bytes_per_step_raw": eng.grad_collective_bytes_raw(state),
         "grad_compression": eng.grad_codec.name,
+        # communication/compute overlap (--grad-bucket-mb): exposed
+        # collective seconds still on the critical path vs hidden behind
+        # compute (parallel/overlap.py probe; exposed is the `analyze
+        # diff` gate — BASELINE.md).  None: probe unavailable.
+        "grad_bucket_mb": grad_bucket_mb,
+        "grad_collective_exposed_s": (overlap_probe or {}).get("exposed_s"),
+        "grad_collective_hidden_s": (overlap_probe or {}).get("hidden_s"),
+        "collective_overlap": overlap_probe,
         # --checkpoint-every: blocked-vs-overlapped checkpoint seconds of
         # the Trainer window (async manager; observability/report rule —
         # only wait_s is charged against throughput)
@@ -425,6 +544,17 @@ def bench_throughput(grad_compression: str = "none",
         "global_batch": global_batch,
         "dtype": "float32",
         "synthetic": bool(ds.synthetic),
+        # attribution (the r03–r05 lesson): which toolchain/flags made
+        # these numbers — diffable across containers
+        "jax_version": jax.__version__,
+        "xla_flags": os.environ.get("XLA_FLAGS"),
+        "libtpu_init_args": os.environ.get("LIBTPU_INIT_ARGS"),
+        # partial-results mode: present iff some window died after others
+        # completed — the medians above cover the completed windows only
+        **({"partial": {"errors": partial_errors,
+                        "scan_windows": len(scan_rates),
+                        "dispatch_windows": len(dispatch_rates)}}
+           if partial_errors else {}),
     }))
 
 
@@ -433,7 +563,8 @@ def bench_throughput(grad_compression: str = "none",
 # ---------------------------------------------------------------------------
 
 def bench_stream(steps: int = 100, grad_compression: str = "none",
-                 health: str = "off", checkpoint_every: int = 0) -> None:
+                 health: str = "off", checkpoint_every: int = 0,
+                 grad_bucket_mb: float = 0.0) -> None:
     """Training throughput when every step consumes a FRESH host batch —
     the configuration the C++ prefetcher (native/src/pipeline.cc) exists
     for.  'resident' (one device batch reused, the default bench) bounds the
@@ -446,16 +577,16 @@ def bench_stream(steps: int = 100, grad_compression: str = "none",
     from distributed_tensorflow_tpu.native import load as native_load
     from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
-    mesh = meshlib.create_mesh()
+    mesh = with_backend_retry(meshlib.create_mesh)
     n = mesh.shape[meshlib.DATA_AXIS]
     global_batch = PER_CHIP_BATCH * n
 
     ds = load_dataset("mnist", split="train")
     model = create_model("cnn", num_classes=ds.num_classes)
-    eng = SyncEngine(model, mesh=mesh, grad_compression=grad_compression)
+    eng = SyncEngine(model, mesh=mesh, grad_compression=grad_compression,
+                     grad_bucket_mb=grad_bucket_mb)
     if health == "on":
         eng.enable_health()  # before init_state: capture slots in tx.init
-    state = eng.init_state(jax.random.key(0), ds.x[:n])
 
     def run_epoch_stream(native: bool | None, st, max_steps: int):
         done = 0
@@ -475,11 +606,22 @@ def bench_stream(steps: int = 100, grad_compression: str = "none",
         return st, done * global_batch / (time.perf_counter() - t0)
 
     # compile + warm both producer paths (the native pass also constructs
-    # the C++ pool and staging buffers outside the timed window)
-    state, _ = run_epoch_stream(False, state, WARMUP_STEPS)
+    # the C++ pool and staging buffers outside the timed window) — through
+    # the same bounded retry as the default bench's warmup: a lease that
+    # wedges between probe and first compile is the r03 failure shape, and
+    # --stream must survive it too.  Self-contained per attempt (state
+    # re-inited): a half-failed warmup may have consumed its donated
+    # state buffers.
     have_native = native_load() is not None
-    if have_native:
-        state, _ = run_epoch_stream(True, state, WARMUP_STEPS)
+
+    def _warm():
+        st = eng.init_state(jax.random.key(0), ds.x[:n])
+        st, _ = run_epoch_stream(False, st, WARMUP_STEPS)
+        if have_native:
+            st, _ = run_epoch_stream(True, st, WARMUP_STEPS)
+        return st
+
+    state = with_backend_retry(_warm, "first compile/warmup")
 
     rows: dict[str, float] = {}
     for label, native in [("python", False)] + (
@@ -583,6 +725,10 @@ def bench_stream(steps: int = 100, grad_compression: str = "none",
             if "native" in producer else None),
         "device": jax.devices()[0].device_kind,
         "synthetic": bool(ds.synthetic),
+        "grad_bucket_mb": grad_bucket_mb,
+        "jax_version": jax.__version__,
+        "xla_flags": os.environ.get("XLA_FLAGS"),
+        "libtpu_init_args": os.environ.get("LIBTPU_INIT_ARGS"),
     }))
 
 
@@ -994,6 +1140,15 @@ def main() -> None:
                         "training benches (parallel/compression.py); the "
                         "JSON line reports grad_bytes_per_step wire vs raw "
                         "either way")
+    p.add_argument("--grad-bucket-mb", type=float, default=0.0,
+                   metavar="MB",
+                   help="communication/compute overlap for the default/"
+                        "--stream training benches: bucket the gradient "
+                        "collectives (~MB per bucket, parallel/overlap.py) "
+                        "and enable the TPU latency-hiding XLA flags; the "
+                        "default line reports the measured exposed-vs-"
+                        "hidden collective split either way "
+                        "(grad_collective_exposed_s)")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent XLA compilation cache dir — repeat "
                         "bench invocations skip the warmup recompiles")
@@ -1016,6 +1171,14 @@ def main() -> None:
             enable_compile_cache)
 
         enable_compile_cache(args.compile_cache)
+    if args.grad_bucket_mb:
+        # before backend init: the latency-hiding/async-collective flags
+        # apply at compile time (LIBTPU_INIT_ARGS — inert off-TPU); the
+        # emitted line records the effective value for attribution
+        from distributed_tensorflow_tpu.utils.harness import (
+            enable_overlap_flags)
+
+        enable_overlap_flags()
     mode = ("stream" if args.stream else "attention" if args.attention
             else "lm" if args.lm else "moe" if args.moe
             else "decode" if args.decode else "default")
@@ -1027,7 +1190,8 @@ def main() -> None:
             bench_stream(steps=max(args.steps, 1),
                          grad_compression=args.grad_compression,
                          health=args.health,
-                         checkpoint_every=args.checkpoint_every)
+                         checkpoint_every=args.checkpoint_every,
+                         grad_bucket_mb=args.grad_bucket_mb)
         elif mode == "attention":
             bench_attention()
         elif mode == "lm":
@@ -1039,7 +1203,8 @@ def main() -> None:
         else:
             bench_throughput(grad_compression=args.grad_compression,
                              health=args.health,
-                             checkpoint_every=args.checkpoint_every)
+                             checkpoint_every=args.checkpoint_every,
+                             grad_bucket_mb=args.grad_bucket_mb)
     except Exception as e:  # noqa: BLE001 — the artifact must stay parsable
         import traceback
         tb = traceback.format_exc()

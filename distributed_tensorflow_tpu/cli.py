@@ -212,6 +212,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "none is bitwise identical to the uncompressed "
                         "path.  Data-parallel and GSPMD engines; the "
                         "pipeline schedules reject it")
+    p.add_argument("--grad-bucket-mb", type=float, default=0.0,
+                   metavar="MB",
+                   help="communication/compute overlap: partition the "
+                        "gradient pytree into ~MB-sized buckets in "
+                        "reverse-backward order (parallel/overlap.py) so "
+                        "each bucket's collective — composed with "
+                        "--grad-compression, which then codes per bucket "
+                        "— is schedulable behind the remaining backward "
+                        "compute (XLA latency-hiding flags are enabled "
+                        "on TPU; with --grad-accum K > 1 each "
+                        "microbatch's reduce also overlaps the next "
+                        "microbatch's backward).  ~4 recommended; 0 "
+                        "(default) compiles the exact pre-overlap "
+                        "programs.  The run measures and reports the "
+                        "exposed-vs-hidden collective split "
+                        "(grad_collective_exposed_s); pipeline modes "
+                        "reject the flag")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent XLA compilation cache directory "
                         "(jax_compilation_cache_dir): repeat runs and "
@@ -389,6 +406,7 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         warmup_steps=args.warmup_steps,
         grad_accum=args.grad_accum,
         grad_compression=args.grad_compression,
+        grad_bucket_mb=args.grad_bucket_mb,
         compile_cache=args.compile_cache,
         weight_decay=args.weight_decay,
         clip_norm=args.clip_norm,

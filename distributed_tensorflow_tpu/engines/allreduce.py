@@ -378,6 +378,11 @@ class Trainer:
         grad_bytes = eng.grad_collective_bytes(self.state)        # wire
         grad_bytes_raw = eng.grad_collective_bytes_raw(self.state)
         grad_codec = getattr(getattr(eng, "grad_codec", None), "name", "none")
+        # overlap bucketing (parallel/overlap.py): 0.0 when the codec is
+        # unbucketed — the wire figure above is then per-leaf, else
+        # per-bucket (the honest int8 scale accounting)
+        grad_bucket_mb = float(getattr(
+            getattr(eng, "grad_codec", None), "bucket_mb", 0.0) or 0.0)
         if grad_bytes:
             # WIRE bytes one gradient collective moves per round under the
             # engine's --grad-compression codec, plus the raw (f32-era)
@@ -388,6 +393,7 @@ class Trainer:
                          grad_allreduce_bytes=grad_bytes,
                          grad_allreduce_bytes_raw=grad_bytes_raw,
                          grad_compression=grad_codec,
+                         grad_bucket_mb=grad_bucket_mb,
                          n_devices=eng.n_devices)
         timer = StepTimer()
         t0 = time.perf_counter()
@@ -700,7 +706,8 @@ class Trainer:
             "prefetch_fill_wait_s": pf_fill_wait,
             **({"grad_allreduce_bytes": grad_bytes,
                 "grad_allreduce_bytes_raw": grad_bytes_raw,
-                "grad_compression": grad_codec} if grad_bytes else {}),
+                "grad_compression": grad_codec,
+                "grad_bucket_mb": grad_bucket_mb} if grad_bytes else {}),
             # checkpoint cost accounting (MLPerf-style: blocked time is
             # charged against throughput, overlapped time is not):
             # checkpoint_wait_s = training-thread seconds inside save/

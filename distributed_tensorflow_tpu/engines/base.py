@@ -22,6 +22,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distributed_tensorflow_tpu.parallel import collectives as coll
 from distributed_tensorflow_tpu.parallel import compression
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
+from distributed_tensorflow_tpu.parallel import overlap
 
 PyTree = Any
 
@@ -179,14 +180,21 @@ class Engine:
         mesh=None,
         learning_rate: float = 1e-3,
         grad_compression: str | compression.GradCodec = "none",
+        grad_bucket_mb: float = 0.0,
     ):
         self.model = model
         self.tx = optimizer if optimizer is not None else optax.adam(learning_rate)
         self.mesh = mesh if mesh is not None else meshlib.create_mesh()
         self.n_devices = self.mesh.shape[self.axis]
         # cross-device gradient/parameter exchange codec (--grad-compression;
-        # parallel/compression.py): 'none' compiles to the pre-codec program
-        self.grad_codec = compression.make_codec(grad_compression)
+        # parallel/compression.py): 'none' compiles to the pre-codec program.
+        # --grad-bucket-mb > 0 wraps it in the bucketed overlap codec
+        # (parallel/overlap.py): size-targeted reverse-backward buckets
+        # whose independent per-bucket collectives XLA's latency-hiding
+        # scheduler can run behind the remaining backward compute; 0 (the
+        # default) keeps the codec unwrapped — byte-identical programs.
+        self.grad_codec = overlap.make_overlap_codec(grad_compression,
+                                                     grad_bucket_mb)
         self._step_fn = None
         self._eval_fn = None
         self._many_step_fns: dict[int, Callable] = {}  # k → jitted scan drain

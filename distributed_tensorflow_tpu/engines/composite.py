@@ -62,7 +62,8 @@ class CompositeEngine(Engine):
                  aux_weight: float = 0.01, router_z_weight: float = 0.0,
                  overflow_warn_threshold: float = 0.25,
                  overflow_window: int = 50, grad_accum: int = 1,
-                 grad_compression: str = "none"):
+                 grad_compression: str = "none",
+                 grad_bucket_mb: float = 0.0):
         from distributed_tensorflow_tpu.engines.expert_parallel import (
             _OverflowMonitor)
 
@@ -100,7 +101,8 @@ class CompositeEngine(Engine):
         self.overflow_monitor = _OverflowMonitor(overflow_warn_threshold,
                                                  overflow_window)
         super().__init__(model, optimizer, mesh, learning_rate,
-                         grad_compression=grad_compression)
+                         grad_compression=grad_compression,
+                         grad_bucket_mb=grad_bucket_mb)
         self.seq_n = mesh.shape.get(meshlib.SEQ_AXIS, 1)
         self.tp_n = mesh.shape.get(meshlib.MODEL_AXIS, 1)
         impl = getattr(model, "attention_impl", "dense")

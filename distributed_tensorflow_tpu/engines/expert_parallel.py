@@ -129,7 +129,8 @@ class ExpertParallelEngine(Engine):
                  aux_weight: float = 0.01, router_z_weight: float = 0.0,
                  overflow_warn_threshold: float = 0.25,
                  overflow_window: int = 50, grad_accum: int = 1,
-                 grad_compression: str = "none"):
+                 grad_compression: str = "none",
+                 grad_bucket_mb: float = 0.0):
         # (data, expert) base mesh; an optional 'model' axis composes ep×tp
         # — each expert's FFN Megatron-split over it (models/moe.py
         # partition_model), still one GSPMD jit
@@ -147,7 +148,8 @@ class ExpertParallelEngine(Engine):
         self.overflow_monitor = _OverflowMonitor(overflow_warn_threshold,
                                                  overflow_window)
         super().__init__(model, optimizer, mesh, learning_rate,
-                         grad_compression=grad_compression)
+                         grad_compression=grad_compression,
+                         grad_bucket_mb=grad_bucket_mb)
         # tokens shard over the WHOLE mesh (see shard_batch), so batch
         # divisibility is against every device, not just the data axis
         self.n_devices = (mesh.shape[meshlib.DATA_AXIS]

@@ -44,7 +44,8 @@ class SeqParallelEngine(Engine):
     seq_axis = meshlib.SEQ_AXIS
 
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
-                 grad_accum: int = 1, grad_compression: str = "none"):
+                 grad_accum: int = 1, grad_compression: str = "none",
+                 grad_bucket_mb: float = 0.0):
         if mesh is None:
             raise ValueError("SeqParallelEngine requires an explicit "
                              "('data','seq') mesh")
@@ -61,7 +62,8 @@ class SeqParallelEngine(Engine):
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self.grad_accum = grad_accum
         super().__init__(model, optimizer, mesh, learning_rate,
-                         grad_compression=grad_compression)
+                         grad_compression=grad_compression,
+                         grad_bucket_mb=grad_bucket_mb)
         self.seq_n = mesh.shape[self.seq_axis]
         # causal LMs (models/gpt.py) have (B, L) per-token labels that shard
         # over (data, seq) WITH the inputs, and per-device logits that VARY

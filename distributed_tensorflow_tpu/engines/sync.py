@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 from distributed_tensorflow_tpu.engines.base import Engine, TrainState, make_loss_fn
 from distributed_tensorflow_tpu.parallel import collectives as coll
 from distributed_tensorflow_tpu.parallel import compression
+from distributed_tensorflow_tpu.parallel import overlap
 
 
 class SyncEngine(Engine):
@@ -47,7 +48,10 @@ class SyncEngine(Engine):
         self.grad_accum = grad_accum
 
     def _build_step(self):
-        if self.grad_codec.name == "none":
+        # bucketing alone (codec 'none' + --grad-bucket-mb) also takes the
+        # explicit-collective step: the per-bucket psums are what the
+        # latency-hiding scheduler overlaps with backward compute
+        if not compression.codec_active(self.grad_codec):
             return self._build_step_exact()
         return self._build_step_compressed()
 
@@ -138,9 +142,9 @@ class SyncEngine(Engine):
         )
         return jax.jit(smapped, donate_argnums=0)
 
-    def _build_step_compressed(self):
+    def _build_step_compressed(self, codec=None, reduce_in_scan=None):
         """Codec-active step: gradients stay device-local through AD and
-        the ONE explicit collective is the codec's — encode on-device,
+        the explicit collectives are the codec's — encode on-device,
         reduce in the codec's wire dtype, widen back to f32 for the
         optimizer after.  The 1/(n·K) loss scale makes the codec's sum the
         global batch-mean gradient, exactly as the exact path's psum.
@@ -152,10 +156,31 @@ class SyncEngine(Engine):
         automatic AD-transpose psum at the replicated-params boundary, so
         the gradients reach the codec device-local with no ``pcast``
         bookkeeping.  Correctness is covered by the compressed-vs-exact
-        closeness and k-parity tests (tests/test_compression.py)."""
+        closeness and k-parity tests (tests/test_compression.py,
+        tests/test_overlap.py).
+
+        Overlap restructure (``reduce_in_scan``, defaulting to the
+        engine codec's bucketed-ness): with a BUCKETED codec and K > 1
+        microbatches, the reduce moves INSIDE the accumulation scan —
+        microbatch i's bucketed exchange is then data-independent of
+        microbatch i+1's backward, so XLA's latency-hiding scheduler can
+        run them concurrently.  Numerics: Σᵢ psum(gᵢ) instead of
+        psum(Σᵢ gᵢ) — the same value up to fp addition order (the
+        documented accumulation tolerance, MIGRATING.md); the
+        stochastic-rounding key folds the microbatch index so each
+        exchange rounds independently.  Without bucketing the PR 3
+        single-reduce-after-scan program is kept verbatim.
+
+        ``codec`` overrides the engine's codec for the overlap probe's
+        compute-only twin (parallel/overlap.ProbeLocalCodec) — the
+        returned program is fresh, never cached on the engine."""
         loss_fn = make_loss_fn(self.model.apply)
         tx, axis, K = self.tx, self.axis, self.grad_accum
-        codec = self.grad_codec
+        if codec is None:
+            codec = self.grad_codec
+        if reduce_in_scan is None:
+            reduce_in_scan = bool(getattr(self.grad_codec, "bucketed",
+                                          False))
 
         def device_step(state: TrainState, x, y):
             rng = self._per_device_rng(state.rng, state.step)
@@ -190,6 +215,13 @@ class SyncEngine(Engine):
                     # independent dropout per microbatch, like separate steps
                     (_, (l, a)), g = grad_fn(state.params, xc, yc,
                                              jax.random.fold_in(rng, i))
+                    if reduce_in_scan:
+                        # overlap mode: exchange THIS microbatch's buckets
+                        # now — data-independent of the next microbatch's
+                        # backward, so the scheduler can overlap them.
+                        # Independent rounding key per microbatch.
+                        g = codec.all_reduce_sum(
+                            g, axis, rng=jax.random.fold_in(codec_key, i))
                     return (jax.tree.map(jnp.add, g_acc, g),
                             l_acc + l, a_acc + a, i + 1), None
 
@@ -200,8 +232,13 @@ class SyncEngine(Engine):
                                                           (xm, ym))
                 loss, acc = loss / K, acc / K
 
-            # the whole cross-device cost: one compressed allreduce
-            grads = codec.all_reduce_sum(g_local, axis, rng=codec_key)
+            if K > 1 and reduce_in_scan:
+                # already reduced per microbatch inside the scan; the
+                # 1/(n·K) scale made the K-sum of psums the global mean
+                grads = g_local
+            else:
+                # the whole cross-device cost: one compressed allreduce
+                grads = codec.all_reduce_sum(g_local, axis, rng=codec_key)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             metrics = coll.all_reduce_mean({"loss": loss, "accuracy": acc}, axis)
@@ -217,3 +254,50 @@ class SyncEngine(Engine):
             #                   prove (gather-based codec collectives)
         )
         return jax.jit(smapped, donate_argnums=0)
+
+    # ------------------------------------------------------ overlap probe
+    def _build_collective_only(self, codec):
+        """The gradient exchange ALONE, over param-shaped values: the
+        codec's collective under the same shard_map rendering as the
+        step, nothing else in the program.  Deterministic rounding (no
+        rng) — the probe times it, nothing consumes the values."""
+        axis = self.axis
+
+        def device_collective(tree):
+            return codec.all_reduce_sum(tree, axis)
+
+        smapped = jax.shard_map(
+            device_collective, mesh=self.mesh,
+            in_specs=(P(),), out_specs=P(),
+            check_vma=False,  # same unprovable-replication story as the
+            #                   compressed step's codec collectives
+        )
+        return jax.jit(smapped)
+
+    def build_overlap_probe_fns(self):
+        """The three programs parallel/overlap.probe_engine_overlap times
+        to split exposed vs hidden collective seconds:
+
+        * ``full``       — the codec-active step (the engine's real
+          program when a codec/bucketing is on; the same math rendered
+          through the explicit-collective step otherwise, so the probe
+          always has a collective it can elide);
+        * ``compute``    — the same step with every collective elided
+          (ProbeLocalCodec): the compute-only twin;
+        * ``collective`` — the gradient exchange alone.
+
+        All three are fresh jitted programs — nothing here touches the
+        engine's cached step, and the probe's states are its own copies
+        (the step programs donate their inputs)."""
+        codec = (self.grad_codec
+                 if compression.codec_active(self.grad_codec)
+                 else compression.GradCodec())
+        reduce_in_scan = bool(getattr(self.grad_codec, "bucketed", False))
+        return {
+            "full": self._build_step_compressed(
+                codec=codec, reduce_in_scan=reduce_in_scan),
+            "compute": self._build_step_compressed(
+                codec=overlap.ProbeLocalCodec(),
+                reduce_in_scan=reduce_in_scan),
+            "collective": self._build_collective_only(codec),
+        }

@@ -78,14 +78,16 @@ class TensorParallelEngine(Engine):
     """
 
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
-                 grad_accum: int = 1, grad_compression: str = "none"):
+                 grad_accum: int = 1, grad_compression: str = "none",
+                 grad_bucket_mb: float = 0.0):
         if mesh is None or set(mesh.axis_names) != {meshlib.DATA_AXIS,
                                                     meshlib.MODEL_AXIS}:
             raise ValueError("TensorParallelEngine requires a ('data','model') mesh")
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         super().__init__(model, optimizer, mesh, learning_rate,
-                         grad_compression=grad_compression)
+                         grad_compression=grad_compression,
+                         grad_bucket_mb=grad_bucket_mb)
         self.grad_accum = grad_accum
 
     def init_state(self, rng, sample_x) -> TrainState:

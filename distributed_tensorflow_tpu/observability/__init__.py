@@ -37,7 +37,8 @@ materialized once per chunk (one host sync per k steps), so enabling
 to ``steps_per_call=1`` (see Trainer.resolve_steps_per_call).
 """
 
-from distributed_tensorflow_tpu.observability.report import build_run_report
+from distributed_tensorflow_tpu.observability.report import (
+    build_run_report, runtime_environment)
 from distributed_tensorflow_tpu.observability.sink import (
     SCHEMA_VERSION, AsyncJsonlSink)
 from distributed_tensorflow_tpu.observability.trace import (
@@ -50,6 +51,7 @@ __all__ = [
     "SCHEMA_VERSION",
     "Tracer",
     "build_run_report",
+    "runtime_environment",
 ]
 
 

@@ -257,6 +257,11 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     ("step_time_mean_s", "lower"), ("compile_s", "lower"),
     ("elapsed_s", "lower"), ("telemetry_overhead_frac", "lower"),
     ("grad_allreduce_bytes", "lower"),
+    # exposed gradient-collective seconds (run report AND bench line —
+    # the communication/compute-overlap gate, BASELINE.md: exposed time
+    # is the number that must go down; hidden_s is deliberately NOT
+    # compared — burying more collective time under compute is the point)
+    ("grad_collective_exposed_s", "lower"),
     # training-thread seconds blocked on checkpointing (run report /
     # fit result; overlapped_s is deliberately NOT compared — moving work
     # onto the background writer is the point, not a regression)

@@ -19,9 +19,43 @@ cannot:
 
 from __future__ import annotations
 
+import os
 from typing import Any
 
 from distributed_tensorflow_tpu.observability.sink import SCHEMA_VERSION
+
+
+def runtime_environment() -> dict[str, Any]:
+    """The execution-environment facts that make perf numbers attributable
+    across containers (the r03–r05 lesson: a bench trajectory without
+    them cannot be compared): jax version, device kind, and the effective
+    XLA flag carriers (``XLA_FLAGS`` / ``LIBTPU_INIT_ARGS`` — the overlap
+    flags ``utils/harness.enable_overlap_flags`` sets ride the latter).
+    The jax fields degrade to None rather than force a backend where none
+    was initialized by the caller's run."""
+    env: dict[str, Any] = {
+        "jax_version": None,
+        "device_kind": None,
+        "xla_flags": os.environ.get("XLA_FLAGS"),
+        "libtpu_init_args": os.environ.get("LIBTPU_INIT_ARGS"),
+    }
+    try:
+        import jax
+
+        env["jax_version"] = jax.__version__
+        # device_kind only when a backend ALREADY exists: jax.local_devices()
+        # would otherwise initialize one as a side effect, locking in
+        # whatever LIBTPU_INIT_ARGS/XLA_FLAGS are set NOW and silently
+        # ignoring flags the caller (e.g. enable_overlap_flags) meant to
+        # apply before its own init — the exact misattribution this
+        # section exists to prevent
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "_backends", None):
+            env["device_kind"] = jax.local_devices()[0].device_kind
+    except Exception:
+        pass
+    return env
 
 
 def build_run_report(fit_result: dict[str, Any], *,
@@ -54,6 +88,17 @@ def build_run_report(fit_result: dict[str, Any], *,
         "grad_allreduce_bytes_raw": fit_result.get(
             "grad_allreduce_bytes_raw"),
         "grad_compression": fit_result.get("grad_compression"),
+        # communication/compute overlap (--grad-bucket-mb;
+        # parallel/overlap.py): the bucket size in effect, and the
+        # exposed-vs-hidden collective split the one-time probe measured
+        # (exposed_s is the gated number — BASELINE.md; None = overlap
+        # off or probe unsupported, distinguishable from a measured 0.0)
+        "grad_bucket_mb": fit_result.get("grad_bucket_mb"),
+        "grad_collective_exposed_s": (
+            fit_result.get("collective_overlap") or {}).get("exposed_s"),
+        "grad_collective_hidden_s": (
+            fit_result.get("collective_overlap") or {}).get("hidden_s"),
+        "collective_overlap": fit_result.get("collective_overlap"),
         # steady-state percentiles (compile excluded — see StepTimer)
         "compile_s": st.get("compile_s", st.get("first_step_s")),
         "step_time_p50_s": st.get("steady_p50_s"),
@@ -105,6 +150,11 @@ def build_run_report(fit_result: dict[str, Any], *,
         report["trace"] = None
     if metrics_logger is not None:
         overhead += getattr(metrics_logger, "overhead_s", 0.0)
+
+    # execution environment (jax version, device kind, effective XLA
+    # flags): bench/report trajectories stay attributable across
+    # containers — the r03–r05 measurement-blackout lesson
+    report["environment"] = runtime_environment()
 
     # the telemetry's own measured cost, against the run's wall clock —
     # this is the number the 5%-overhead acceptance bound reads

@@ -1,5 +1,6 @@
-"""Parallelism layer: mesh runtime (L0), collectives (L1), and the
-gradient-compression codecs that shrink what the collectives carry."""
+"""Parallelism layer: mesh runtime (L0), collectives (L1), the
+gradient-compression codecs that shrink what the collectives carry, and
+the overlap layer that hides their latency behind backward compute."""
 
 from distributed_tensorflow_tpu.parallel import (  # noqa: F401
-    collectives, compression, mesh)
+    collectives, compression, mesh, overlap)

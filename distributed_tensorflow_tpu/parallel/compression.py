@@ -338,6 +338,17 @@ class Int8Codec(GradCodec):
 _CODEC_CLASSES = {c.name: c for c in (GradCodec, Bf16Codec, Int8Codec)}
 
 
+def codec_active(codec: GradCodec) -> bool:
+    """True when the codec changes the collective program: a real
+    compression codec, or the 'none' passthrough wrapped in bucketing
+    (parallel/overlap.BucketedCodec — bucketed-none still replaces the
+    monolithic exchange with per-bucket collectives the latency-hiding
+    scheduler can overlap).  Engines branch on this instead of
+    ``codec.name != 'none'`` wherever bucketing alone must activate the
+    explicit-collective step."""
+    return codec.name != "none" or bool(getattr(codec, "bucketed", False))
+
+
 def make_codec(compression: str | GradCodec | None) -> GradCodec:
     """Resolve a ``--grad-compression`` value (or a ready codec instance)
     to a :class:`GradCodec`."""

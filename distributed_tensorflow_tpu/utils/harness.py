@@ -61,6 +61,17 @@ class ExperimentConfig:
                                     # exchange codec: none | bf16 | int8
                                     # (parallel/compression.py; pipeline
                                     # modes reject it)
+    grad_bucket_mb: float = 0.0     # >0: communication/compute overlap —
+                                    # partition the grad pytree into
+                                    # size-targeted buckets (reverse-
+                                    # backward order, parallel/overlap.py)
+                                    # whose independent collectives XLA's
+                                    # latency-hiding scheduler runs behind
+                                    # backward compute; the codec applies
+                                    # per bucket.  0 (default): bitwise
+                                    # pre-overlap programs.  ~4 is the
+                                    # recommended size; pipeline modes
+                                    # reject it like grad_compression
     compile_cache: str | None = None  # persistent XLA compilation cache
                                     # dir (jax_compilation_cache_dir):
                                     # repeat runs skip recompiles
@@ -193,6 +204,42 @@ def enable_compile_cache(directory: str | os.PathLike) -> str:
     return str(path)
 
 
+# XLA knobs that let the TPU compiler actually HIDE the bucketed gradient
+# collectives parallel/overlap.py makes schedulable: the latency-hiding
+# scheduler plus async-collective fusion (the production TPU overlap set).
+# They ride LIBTPU_INIT_ARGS — read only by libtpu, so setting them is
+# inert on CPU/GPU containers (an unknown flag in XLA_FLAGS would abort
+# backend init; LIBTPU_INIT_ARGS is the safe carrier).  The effective
+# values are recorded in the run report's `environment` section
+# (observability/report.runtime_environment) so bench trajectories stay
+# attributable across containers.
+OVERLAP_XLA_TPU_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_enable_async_all_gather=true",
+)
+
+
+def enable_overlap_flags(env=None) -> str:
+    """Append the communication/compute-overlap XLA flags to
+    ``LIBTPU_INIT_ARGS`` (idempotent: a flag whose key is already present
+    — e.g. user-overridden to false — is left alone).  Must run BEFORE
+    backend initialization; ``run()`` and ``bench.py`` call it when
+    ``--grad-bucket-mb`` > 0.  Returns the resulting value, which the run
+    report records for reproducibility."""
+    env = os.environ if env is None else env
+    parts = env.get("LIBTPU_INIT_ARGS", "").split()
+    have = {p.split("=", 1)[0] for p in parts}
+    for flag in OVERLAP_XLA_TPU_FLAGS:
+        if flag.split("=", 1)[0] not in have:
+            parts.append(flag)
+    env["LIBTPU_INIT_ARGS"] = " ".join(parts)
+    return env["LIBTPU_INIT_ARGS"]
+
+
 @dataclasses.dataclass
 class _Experiment:
     """Resolved experiment: mesh, data, model, engine, global batch.
@@ -231,6 +278,30 @@ def _is_pipeline(engine) -> bool:
     return isinstance(engine, PipelineEngine)
 
 
+def _validate_grad_bucket(config: ExperimentConfig) -> None:
+    """Reject bad --grad-bucket-mb configs.  Called from _setup AND from
+    run() BEFORE enable_overlap_flags() — the overlap flags mutate
+    process-global LIBTPU_INIT_ARGS, so a config that _setup would reject
+    must never get to mutate the environment of later runs in the same
+    process."""
+    if not config.grad_bucket_mb:
+        return
+    if config.grad_bucket_mb < 0:
+        raise ValueError(
+            f"--grad-bucket-mb must be >= 0 (0 disables bucketing), "
+            f"got {config.grad_bucket_mb}")
+    if config.pipeline_parallel > 1:
+        # same named rejection as --grad-compression: the pipeline
+        # schedules own per-stage params inside a manual shard_map
+        # axis — there is no single post-AD gradient tree to bucket
+        raise ValueError(
+            "--grad-bucket-mb is implemented for the data-parallel "
+            "and GSPMD engines (sync/async/allreduce/gossip/fsdp, -tp, "
+            "-sp, -ep and their composites); the pipeline schedules "
+            "(-pp) are not supported — drop the flag or train "
+            "without -pp")
+
+
 def _setup(config: ExperimentConfig) -> _Experiment:
     # the z-loss is applied by the MoE-aware engines: the -ep paths, and
     # the tp×sp composite when the model carries MoE blocks
@@ -261,6 +332,7 @@ def _setup(config: ExperimentConfig) -> _Experiment:
                 "-sp, -ep and their composites); the pipeline schedules "
                 "(-pp) are not supported yet — drop the flag or train "
                 "without -pp")
+    _validate_grad_bucket(config)
     if config.sample_tokens:
         # pipeline runs sample too (sequential-forward decode over the
         # pipe-stacked stages, engines/pipeline.py generate); family/shape
@@ -330,7 +402,8 @@ def _setup(config: ExperimentConfig) -> _Experiment:
     engine_kw: dict[str, Any] = dict(
         mesh=mesh, learning_rate=config.learning_rate,
         optimizer=_make_optimizer(config, train_ds, global_batch),
-        grad_compression=config.grad_compression)
+        grad_compression=config.grad_compression,
+        grad_bucket_mb=config.grad_bucket_mb)
     if config.engine == "async":
         engine_kw["sync_every"] = config.sync_every
     elif config.engine == "gossip":
@@ -608,7 +681,8 @@ def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
         optimizer=_make_optimizer(config, train_ds,
                                   _global_batch(config, dp)),
         grad_accum=config.grad_accum,
-        grad_compression=config.grad_compression)
+        grad_compression=config.grad_compression,
+        grad_bucket_mb=config.grad_bucket_mb)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name=f"seq_parallel[{config.attention_impl}]")
@@ -652,7 +726,8 @@ def _setup_tensor_parallel(config: ExperimentConfig) -> _Experiment:
         optimizer=_make_optimizer(config, train_ds,
                                   _global_batch(config, dp)),
         grad_accum=config.grad_accum,
-        grad_compression=config.grad_compression)
+        grad_compression=config.grad_compression,
+        grad_bucket_mb=config.grad_bucket_mb)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name="tensor_parallel")
@@ -678,7 +753,8 @@ def _setup_fsdp_tp(config: ExperimentConfig) -> _Experiment:
         optimizer=_make_optimizer(config, train_ds,
                                   _global_batch(config, dp)),
         grad_accum=config.grad_accum,
-        grad_compression=config.grad_compression)
+        grad_compression=config.grad_compression,
+        grad_bucket_mb=config.grad_bucket_mb)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name="fsdp_tp[fsdp*tp]")
@@ -845,7 +921,8 @@ def _setup_composite(config: ExperimentConfig) -> _Experiment:
         aux_weight=config.aux_weight,
         router_z_weight=config.router_z_weight,
         grad_accum=config.grad_accum,
-        grad_compression=config.grad_compression)
+        grad_compression=config.grad_compression,
+        grad_bucket_mb=config.grad_bucket_mb)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name=f"composite[dp*tp*sp,{config.attention_impl}]")
@@ -1073,7 +1150,8 @@ def _setup_expert_parallel(config: ExperimentConfig,
         aux_weight=config.aux_weight,
         router_z_weight=config.router_z_weight,
         grad_accum=config.grad_accum,
-        grad_compression=config.grad_compression)
+        grad_compression=config.grad_compression,
+        grad_bucket_mb=config.grad_bucket_mb)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine,
                        global_batch=_global_batch(config, n_token_shards),
@@ -1181,7 +1259,8 @@ def _setup_expert_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
         aux_weight=config.aux_weight,
         router_z_weight=config.router_z_weight,
         grad_accum=config.grad_accum,
-        grad_compression=config.grad_compression)
+        grad_compression=config.grad_compression,
+        grad_bucket_mb=config.grad_bucket_mb)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name=(f"expert_tp_sp[dp*ep*tp*sp,{config.attention_impl}]" if tp > 1
@@ -1212,6 +1291,14 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         # before any compile: the whole run's programs become cache hits
         # on the next invocation with the same cache dir
         enable_compile_cache(config.compile_cache)
+    if config.grad_bucket_mb:
+        # before backend init: the latency-hiding/async-collective flags
+        # only take effect at compile time (recorded in the run report's
+        # `environment` section either way).  Validate FIRST — a config
+        # _setup would reject must not leave LIBTPU_INIT_ARGS mutated for
+        # later runs in this process
+        _validate_grad_bucket(config)
+        enable_overlap_flags()
     ex = _setup(config)
     # numeric-health layer: must be enabled BEFORE any state init (the
     # optimizer tree gains its capture slots at tx.init) — including the
@@ -1299,6 +1386,14 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     tracer = Tracer(path=config.trace_path,
                     process_index=jax.process_index())
 
+    # one-time exposed-vs-hidden collective measurement (the overlap
+    # opt-in pays two extra step compiles for the number BASELINE.md
+    # gates): spanned/evented as `collective_overlap`, surfaced by the
+    # run report as grad_collective_exposed_s / grad_collective_hidden_s
+    overlap_probe = None
+    if config.grad_bucket_mb:
+        overlap_probe = _probe_collective_overlap(ex, global_batch, tracer)
+
     from distributed_tensorflow_tpu.utils.metrics import profile
 
     watchdog = None
@@ -1342,6 +1437,11 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         finally:
             if watchdog is not None:
                 watchdog.close()
+        if config.grad_bucket_mb:
+            # ride the fit result into the run report (None when the
+            # probe was unsupported/failed — "measured 0" stays
+            # distinguishable from "not measured")
+            fit["collective_overlap"] = overlap_probe
         sink.done(fit["elapsed"])
         with tracer.span("eval", final=True):
             ev = trainer.evaluate(test_ds, batch_size=config.eval_batch)
@@ -1422,6 +1522,48 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
             metrics_logger.close()  # drain + flush the async JSONL sink
         tracer.close()
         sink.close()
+
+
+def _probe_collective_overlap(ex: _Experiment, global_batch: int, tracer):
+    """One-time exposed-vs-hidden collective split for --grad-bucket-mb
+    runs (parallel/overlap.probe_engine_overlap): spans the measurement as
+    ``collective_overlap`` and emits the split as a ``collective_overlap``
+    event.  Returns the split dict, or None when the engine has no probe
+    (compiler-inserted collectives), the probe fails, or the job is
+    multi-process (the probe's throwaway programs would have to rendezvous
+    across hosts for no benefit) — a failed probe must never kill a
+    training run, it only leaves the report's exposed/hidden keys None."""
+    from distributed_tensorflow_tpu.parallel import overlap as overlaplib
+
+    result = None
+    error = None
+    with tracer.span("collective_overlap", probe=True):
+        try:
+            if jax.process_count() > 1:
+                error = "probe skipped on multi-process jobs"
+            else:
+                batch = None
+                for bx, by, _bm in ex.train_ds.batches(global_batch,
+                                                       shuffle=False):
+                    batch = (bx, by)
+                    break
+                if batch is None:
+                    error = "dataset yielded no probe batch"
+                else:
+                    xs, ys = ex.engine.shard_batch(*batch)
+                    result = overlaplib.probe_engine_overlap(
+                        ex.engine, xs, ys,
+                        sample_x=ex.train_ds.x[: max(1, ex.n)])
+                    if result is None:
+                        error = ("engine has no overlap probe "
+                                 "(compiler-inserted collectives)")
+        except Exception as e:
+            error = f"{type(e).__name__}: {e}"
+    if result is None:
+        tracer.event("collective_overlap", supported=False, error=error)
+        return None
+    tracer.event("collective_overlap", **result)
+    return result
 
 
 def _validate_sampling(config: ExperimentConfig, ex: _Experiment,

@@ -1,0 +1,90 @@
+"""bench.py hardening against a wedged backend lease (ISSUE 6 satellite):
+bounded retry-with-backoff around backend init, and the partial-results
+mode that keeps whatever measurement windows completed — so the r03–r05
+blackout (one mid-run failure → three rounds of null artifacts) cannot
+repeat.  Pure host-level unit tests: the failures are faked, no backend
+is touched."""
+
+import pytest
+
+import bench
+
+
+class _Flaky:
+    """Callable failing ``fail_n`` times before succeeding."""
+
+    def __init__(self, fail_n, exc=RuntimeError("lease wedged")):
+        self.fail_n = fail_n
+        self.exc = exc
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.fail_n:
+            raise self.exc
+        return "backend"
+
+
+def test_backend_retry_succeeds_after_transient_failures():
+    sleeps: list[float] = []
+    logs: list[str] = []
+    fn = _Flaky(fail_n=2)
+    out = bench.with_backend_retry(fn, "fake init", retries=3,
+                                   backoff_s=5.0, sleep=sleeps.append,
+                                   log=logs.append)
+    assert out == "backend" and fn.calls == 3
+    # linear backoff: 5s, then 10s — bounded, never exponential blowup
+    assert sleeps == [5.0, 10.0]
+    assert len(logs) == 2 and "fake init" in logs[0]
+
+
+def test_backend_retry_raises_last_error_when_exhausted():
+    sleeps: list[float] = []
+    fn = _Flaky(fail_n=10, exc=RuntimeError("still wedged"))
+    with pytest.raises(RuntimeError, match="still wedged"):
+        bench.with_backend_retry(fn, "fake init", retries=3,
+                                 backoff_s=1.0, sleep=sleeps.append,
+                                 log=lambda _m: None)
+    assert fn.calls == 3
+    assert sleeps == [1.0, 2.0]  # no sleep after the final attempt
+
+
+def test_backend_retry_env_defaults_are_bounded():
+    assert bench.INIT_RETRIES >= 1
+    assert bench.INIT_BACKOFF_S > 0
+
+
+def test_measure_windows_keeps_completed_values_on_failure():
+    """Partial-results mode: the windows that completed before the
+    failure are kept, and the error is recorded for the JSON line's
+    ``partial`` section — never an all-or-nothing artifact."""
+    def fn(rep):
+        if rep == 2:
+            raise RuntimeError("device lost mid-window")
+        return 100.0 + rep
+
+    errors: list[str] = []
+    vals = bench.measure_windows(fn, 5, "scan", errors)
+    assert vals == [100.0, 101.0]
+    assert len(errors) == 1
+    assert "scan window 3/5" in errors[0]
+    assert "device lost mid-window" in errors[0]
+
+
+def test_measure_windows_clean_run_records_no_errors():
+    errors: list[str] = []
+    vals = bench.measure_windows(lambda rep: float(rep), 3, "scan", errors)
+    assert vals == [0.0, 1.0, 2.0]
+    assert errors == []
+
+
+def test_measure_windows_first_window_failure_yields_empty():
+    """Zero completed windows: the caller raises into the structured-skip
+    path (bench still emits ONE parsable line, never a bare traceback)."""
+    errors: list[str] = []
+
+    def fn(_rep):
+        raise RuntimeError("wedged before any window")
+
+    assert bench.measure_windows(fn, 3, "scan", errors) == []
+    assert len(errors) == 1
