@@ -1073,6 +1073,19 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, vocab: int = 16384,
              f"{per_step * 1e3:.3f} ms/step")
     med, spread = _median_spread(rates)
     steps_per_sec = med / batch
+
+    # TTFT vs per-token split (serving comparability): TTFT is a 1-new-
+    # token generate — the prefill cost the differenced marginal rate
+    # above deliberately cancels — so decode lines report BOTH halves of
+    # a request's latency, like the serving bench and the training
+    # benches' compile-vs-steady split
+    _sync(gpt_generate(model, params, prompt, 1, greedy=True))  # compile
+    ttft_times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        _sync(gpt_generate(model, params, prompt, 1, greedy=True))
+        ttft_times.append(time.perf_counter() - t0)
+    ttft_med, ttft_spread = _median_spread(ttft_times)
     # weights stream once per decode STEP (all B rows share the read);
     # byte count from the ACTUAL param leaf dtypes — flax keeps
     # param_dtype=float32 under bf16 compute today, and summing itemsize
@@ -1089,6 +1102,12 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, vocab: int = 16384,
                   f"median of {REPEATS}",
         "spread": round(spread, 4),
         "ms_per_step": round(1e3 / steps_per_sec, 3),
+        # TTFT (prompt prefill + first token, batch-wide) vs the marginal
+        # per-token decode step — the split serving latency budgets are
+        # written in (BASELINE.md "Serving comparisons")
+        "ttft_s": round(ttft_med, 6),
+        "ttft_spread": round(ttft_spread, 4),
+        "per_token_s": round(1.0 / steps_per_sec, 6),
         "achieved_weight_stream_GBps": round(gbps, 1),
         "params_millions": round(n_params / 1e6, 1),
         "params_bytes": params_bytes,
@@ -1099,6 +1118,201 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, vocab: int = 16384,
         "device": jax.devices()[0].device_kind,
         "n_devices": 1,
         "synthetic": True,
+        # environment attribution (the training benches' r03–r05 lesson):
+        # decode numbers are only comparable across runs when the
+        # toolchain/flags that made them ride the line
+        "jax_version": jax.__version__,
+        "xla_flags": os.environ.get("XLA_FLAGS"),
+        "libtpu_init_args": os.environ.get("LIBTPU_INIT_ARGS"),
+    }))
+
+
+# ---------------------------------------------------------------------------
+# --serve: continuous-batching serving under an open-loop arrival process
+# ---------------------------------------------------------------------------
+
+def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
+    """Serving throughput + latency percentiles of the continuous-batching
+    engine (distributed_tensorflow_tpu/serving/) against the static-batch
+    restart-per-``generate`` baseline, on the SAME synthetic open-loop
+    arrival trace (Poisson arrivals, mixed prompt/continuation lengths) —
+    the BASELINE.md serving rule: equal arrival process, equal latency
+    budget, percentile accounting.
+
+    TTFT/ITL are MLPerf-style latency percentiles (queue wait included in
+    TTFT); the headline is requests/sec/chip.  ``--stream`` exercises the
+    per-token streaming delivery hook (tokens reach the host every decode
+    iteration in both modes; --stream additionally counts deliveries
+    through the callback) and emits the same serve_* key set.  Smoke runs
+    shrink the workload via BENCH_SERVE_* env vars (model dims, slots,
+    request count, arrival rate) exactly like BENCH_PER_CHIP_BATCH."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models import create_model
+    from distributed_tensorflow_tpu.observability import (
+        NULL_TRACER, Tracer, serve_section)
+    from distributed_tensorflow_tpu.parallel import mesh as meshlib
+    from distributed_tensorflow_tpu.serving import (
+        ContinuousBatcher, Request, SlotKVCache)
+
+    env = os.environ.get
+
+    def note(msg):
+        print(f"[bench --serve] {msg}", file=sys.stderr, flush=True)
+
+    hidden = int(env("BENCH_SERVE_HIDDEN", "512"))
+    layers = int(env("BENCH_SERVE_LAYERS", "8"))
+    heads = int(env("BENCH_SERVE_HEADS", "8"))
+    ffn = int(env("BENCH_SERVE_FFN", "2048"))
+    vocab = int(env("BENCH_SERVE_VOCAB", "16384"))
+    prompt_len = int(env("BENCH_SERVE_PROMPT_LEN", "32"))
+    max_new = int(env("BENCH_SERVE_MAX_NEW", "64"))
+    slots = int(env("BENCH_SERVE_SLOTS", "8"))
+    n_requests = int(env("BENCH_SERVE_REQUESTS", "32"))
+    rate = float(env("BENCH_SERVE_RATE", "4"))  # requests/sec, open loop
+    repeats = int(env("BENCH_SERVE_REPEATS", "3"))
+
+    mesh = with_backend_retry(meshlib.create_mesh)
+    n = mesh.shape[meshlib.DATA_AXIS]
+    if slots % n:
+        slots = ((slots + n - 1) // n) * n  # slot dim shards over 'data'
+    device_kind = jax.devices()[0].device_kind
+
+    max_len = prompt_len + max_new
+    model = create_model("gpt", num_classes=vocab, hidden=hidden,
+                         layers=layers, heads=heads, ffn=ffn,
+                         max_len=max_len, dropout_rate=0.0,
+                         dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+
+    def _init():
+        dummy = jnp.zeros((1, prompt_len), jnp.int32)
+        return jax.jit(lambda k: model.init(k, dummy, train=False))(
+            jax.random.key(0))["params"]
+
+    params = with_backend_retry(_init, "param init")
+    _sync(params)
+    note(f"init done in {time.perf_counter() - t0:.0f}s")
+
+    # one open-loop arrival trace shared by BOTH modes and ALL windows:
+    # Poisson arrivals at `rate`, mixed prompt and continuation lengths
+    # (the staggered-traffic shape static batching idles on)
+    arrivals = rng.exponential(1.0 / max(rate, 1e-9), n_requests).cumsum()
+    p_lens = rng.integers(max(prompt_len // 2, 1), prompt_len + 1,
+                          n_requests)
+    n_news = rng.integers(max(max_new // 2, 1), max_new + 1, n_requests)
+    prompts = [rng.integers(0, vocab, pl).astype(np.int32)
+               for pl in p_lens]
+
+    def workload():
+        return [Request(rid=i, prompt=prompts[i],
+                        max_new_tokens=int(n_news[i]),
+                        arrival_s=float(arrivals[i]))
+                for i in range(n_requests)]
+
+    kv = SlotKVCache(model, params, slots, mesh=mesh)
+
+    def _warm():
+        # compile the decode step + every prefill bucket the workload
+        # will hit, outside the timed windows (first-request TTFT must
+        # measure serving, not XLA)
+        lens = [len(p) for p in prompts]
+        for plen in sorted(set(lens)):
+            slot, _ = kv.insert(prompts[lens.index(plen)])
+            kv.advance()
+            kv.evict(slot)
+        note(f"warm: {kv.compiled_programs()}")
+
+    with_backend_retry(_warm, "first compile/warmup")
+
+    tracer = Tracer(path=trace_path) if trace_path else NULL_TRACER
+    partial_errors: list[str] = []
+    delivered = [0]
+    on_token = ((lambda rid, tok: delivered.__setitem__(0, delivered[0] + 1))
+                if stream else None)
+
+    def window(mode):
+        def _one(rep):
+            delivered[0] = 0   # per-window count: the emitted number must
+            batcher = ContinuousBatcher(kv, tracer=tracer, mode=mode)
+            summary = serve_section(batcher.run(workload(),
+                                                on_token=on_token), n)
+            if stream:         # describe ONE window, not every mode×repeat
+                summary["tokens_delivered"] = delivered[0]
+            note(f"{mode} window {rep}: "
+                 f"{summary['serve_requests_per_sec_per_chip']:.3f} "
+                 f"req/s/chip, ttft_p95 "
+                 f"{summary['serve_ttft_p95_s'] * 1e3:.1f} ms, "
+                 f"{summary['decode_iterations']} decode iterations")
+            return summary
+        return _one
+
+    try:
+        cont = measure_windows(window("continuous"), repeats, "serve",
+                               partial_errors)
+        if not cont:
+            raise RuntimeError(f"no serve window completed: "
+                               f"{partial_errors[-1]}")
+        stat = measure_windows(window("static"), repeats, "serve_static",
+                               partial_errors)
+    finally:
+        # drain the span sink even when every window died — the spans up
+        # to the failure are exactly the ones worth keeping
+        tracer.close()
+
+    def med(windows, key):
+        vals = [w[key] for w in windows if w.get(key) is not None]
+        return statistics.median(vals) if vals else None
+
+    serve_keys = ("serve_requests_per_sec_per_chip",
+                  "serve_requests_per_sec", "serve_tokens_per_sec",
+                  "serve_ttft_p50_s", "serve_ttft_p95_s",
+                  "serve_itl_p50_s", "serve_itl_p95_s")
+    line = {k: med(cont, k) for k in serve_keys}
+    rps = line["serve_requests_per_sec_per_chip"]
+    static_rps = med(stat, "serve_requests_per_sec_per_chip")
+    print(json.dumps({
+        "metric": "gpt_serve_requests_per_sec_per_chip",
+        "value": round(rps, 4) if rps else None,
+        "unit": "requests/sec/chip",
+        "vs_baseline": None,
+        "method": (f"continuous batching, {slots} slots, open-loop "
+                   f"Poisson {rate}/s × {n_requests} requests, median "
+                   f"of {len(cont)}"),
+        **{k: (round(v, 6) if isinstance(v, float) else v)
+           for k, v in line.items()},
+        "serve_decode_iterations": med(cont, "decode_iterations"),
+        "serve_completed": med(cont, "completed"),
+        # the static-batch generate baseline on the SAME arrival trace —
+        # the headline claim is the ratio at equal latency budget
+        "static_requests_per_sec_per_chip": (
+            round(static_rps, 6) if static_rps else None),
+        "static_ttft_p95_s": med(stat, "serve_ttft_p95_s"),
+        "static_itl_p95_s": med(stat, "serve_itl_p95_s"),
+        "static_decode_iterations": med(stat, "decode_iterations"),
+        "continuous_vs_static": (round(rps / static_rps, 3)
+                                 if rps and static_rps else None),
+        "stream": stream,
+        **({"tokens_delivered": med(cont, "tokens_delivered")}
+           if stream else {}),
+        "config": {"slots": slots, "requests": n_requests,
+                   "arrival_rate_per_s": rate, "prompt_len": prompt_len,
+                   "max_new_tokens": max_new, "vocab": vocab,
+                   "hidden": hidden, "layers": layers, "heads": heads,
+                   "ffn": ffn, "max_len": max_len, "dtype": "bfloat16",
+                   "greedy": True},
+        "device": device_kind,
+        "n_devices": n,
+        "synthetic": True,
+        "jax_version": jax.__version__,
+        "xla_flags": os.environ.get("XLA_FLAGS"),
+        "libtpu_init_args": os.environ.get("LIBTPU_INIT_ARGS"),
+        **({"partial": {"errors": partial_errors,
+                        "serve_windows": len(cont),
+                        "static_windows": len(stat)}}
+           if partial_errors else {}),
     }))
 
 
@@ -1108,6 +1322,7 @@ _MODE_METRICS = {
     "lm": "gpt_lm_sync_tokens_per_sec_per_chip",
     "moe": "gpt_moe_sync_tokens_per_sec_per_chip",
     "decode": "gpt_lm_decode_tokens_per_sec_per_chip",
+    "serve": "gpt_serve_requests_per_sec_per_chip",
     "default": "mnist_cnn_sync_examples_per_sec_per_chip",
 }
 
@@ -1126,6 +1341,18 @@ def main() -> None:
     p.add_argument("--decode", action="store_true",
                    help="KV-cache decode throughput (tokens/sec + achieved "
                         "weight-streaming bandwidth) of the --lm config")
+    p.add_argument("--serve", action="store_true",
+                   help="continuous-batching serving bench: open-loop "
+                        "Poisson arrivals through the slot-based KV cache "
+                        "(serving/) vs the static-batch generate baseline "
+                        "on the same trace; reports requests/sec/chip + "
+                        "TTFT/ITL p50/p95 (combine with --stream for the "
+                        "per-token streaming delivery mode; "
+                        "BENCH_SERVE_* env vars shrink smoke runs)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="--serve: write the scheduler's request/prefill/"
+                        "decode span timeline to this JSONL (readable by "
+                        "observability.analyze spans/export)")
     p.add_argument("--steps", type=int, default=100,
                    help="--stream: measured steps per repetition (the test "
                         "suite's smoke invocation shrinks this, plus "
@@ -1179,14 +1406,19 @@ def main() -> None:
             enable_overlap_flags)
 
         enable_overlap_flags()
-    mode = ("stream" if args.stream else "attention" if args.attention
+    # --serve wins over --stream: "--serve --stream" is the serving
+    # bench's per-token streaming mode, not the input-pipeline bench
+    mode = ("serve" if args.serve else "stream" if args.stream
+            else "attention" if args.attention
             else "lm" if args.lm else "moe" if args.moe
             else "decode" if args.decode else "default")
     metric = _MODE_METRICS[mode]
     if not args.no_probe:
         ensure_backend(metric)
     try:
-        if mode == "stream":
+        if mode == "serve":
+            bench_serve(stream=args.stream, trace_path=args.trace)
+        elif mode == "stream":
             bench_stream(steps=max(args.steps, 1),
                          grad_compression=args.grad_compression,
                          health=args.health,

@@ -167,6 +167,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sample-prompt-len", type=int, default=8,
                    help="prompt tokens taken from the test split per "
                         "sampled row (--sample)")
+    p.add_argument("--serve", type=int, default=0, metavar="N",
+                   help="after training a GPT LM, run a continuous-"
+                        "batching serving window of N requests through "
+                        "the slot-based KV cache + in-flight scheduler "
+                        "(distributed_tensorflow_tpu/serving/): requests "
+                        "queue into --serve-slots slots, finished slots "
+                        "are evicted and refilled between decode "
+                        "iterations, and the summary/run report gain a "
+                        "'serve' section (requests/sec/chip, TTFT/ITL "
+                        "p50/p95 — gated by `analyze diff` like the "
+                        "training metrics).  Per-request request/prefill/"
+                        "decode spans ride --trace")
+    p.add_argument("--serve-slots", type=int, default=4,
+                   help="--serve: KV slot table size (requests decoded "
+                        "in flight at once; shards over the 'data' mesh "
+                        "axis when divisible)")
+    p.add_argument("--serve-max-new", type=int, default=16,
+                   help="--serve: tokens generated per request")
+    p.add_argument("--serve-prompt-len", type=int, default=8,
+                   help="--serve: prompt tokens taken from the test "
+                        "split per request")
     p.add_argument("--model-arg", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="extra model constructor field (repeatable), e.g. "
@@ -450,6 +471,10 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         max_restarts=args.max_restarts,
         sample_tokens=args.sample,
         sample_prompt_len=args.sample_prompt_len,
+        serve_requests=args.serve,
+        serve_slots=args.serve_slots,
+        serve_max_new=args.serve_max_new,
+        serve_prompt_len=args.serve_prompt_len,
     )
     summary = run(config)  # run() itself wraps recovery when max_restarts>0
     print(json.dumps(summary))

@@ -95,6 +95,17 @@ class Dataset:
         bs = batch_size or self.batch_size
         if bs is None:
             raise ValueError("batch_size not set; pass it or use with_batching()")
+        if getattr(self.y, "ndim", 1) > 1:
+            # the C++ gather stages SCALAR labels (native/batcher.py fills
+            # a (batch,) int32 buffer): an LM dataset's (B, L) next-token
+            # targets would silently flatten to (B,) garbage — gate to the
+            # Python path, loudly when the caller forced native
+            if native:
+                raise RuntimeError(
+                    "the native pipeline gathers scalar labels only; "
+                    f"this dataset's targets are {self.y.ndim - 1}-D per "
+                    "row (LM next-token layout) — use the Python path")
+            native = False
         if native is None and (os.cpu_count() or 1) < 2:
             native = False
         if native is not False:

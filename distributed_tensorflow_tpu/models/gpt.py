@@ -93,6 +93,12 @@ class CausalSelfAttention(nn.Module):
                                # (None = heads, standard MHA; 1 = MQA).
                                # Shrinks the decode cache by heads/kv_heads.
     dtype: jnp.dtype = jnp.float32
+    decode_slots: bool = False   # serving mode: the batch dim is a SLOT
+                               # table (serving/kv_cache.py) — the caller
+                               # passes per-slot write positions, cache
+                               # writes are per-row scatters, and validity
+                               # is length-driven, so one compiled decode
+                               # step advances slots of any age
 
     @nn.compact
     def __call__(self, x, pos=None):
@@ -151,6 +157,52 @@ class CausalSelfAttention(nn.Module):
             import jax
 
             b = x.shape[0]
+            if self.decode_slots:
+                # SLOT decode (serving/kv_cache.py): each batch row is an
+                # independent slot with its own age.  The write index is
+                # the caller-supplied per-slot position (= the slot's
+                # current length), the write a per-row scatter, and the
+                # validity mask length-driven — so the SAME compiled step
+                # advances a slot mid-prefill-history and a slot hundreds
+                # of tokens deep at once.  No cursor/overflow variables:
+                # positions are external state owned by the serving
+                # engine, which guards capacity at admission time
+                # (prompt + max_new_tokens ≤ max_len — the host-side
+                # twin of the scalar path's sticky overflow flag).
+                if pos is None:
+                    raise ValueError(
+                        "decode_slots=True needs per-slot positions "
+                        "(B, 1) — the serving engine passes the slot "
+                        "length vector")
+                ready = self.has_variable("cache", "cached_key")
+                ck = self.variable(
+                    "cache", "cached_key", jnp.zeros,
+                    (b, self.max_len, kvh, head_dim), self.dtype)
+                cv = self.variable(
+                    "cache", "cached_value", jnp.zeros,
+                    (b, self.max_len, kvh, head_dim), self.dtype)
+                if not ready:
+                    out = dense_attention(q, widen(k), widen(v),
+                                          causal=True)
+                else:
+                    idx = pos[:, 0]
+                    rows = jnp.arange(b)
+                    ck.value = ck.value.at[rows, idx].set(k[:, 0])
+                    cv.value = cv.value.at[rows, idx].set(v[:, 0])
+                    valid = (jnp.arange(self.max_len)[None, :]
+                             <= idx[:, None]).astype(self.dtype)
+                    out = dense_attention(
+                        q, widen(ck.value), widen(cv.value),
+                        causal=False, kv_mask=valid)
+                out = out.reshape(out.shape[:-2]
+                                  + (self.heads * head_dim,))
+                # same name="out" as the shared projection below: only one
+                # branch ever executes, so the param tree stays identical
+                # to every other mode — a training checkpoint serves as-is
+                return nn.Dense(
+                    self.hidden, dtype=self.dtype, name="out",
+                    kernel_init=_part(nn.initializers.lecun_normal(),
+                                      (meshlib.MODEL_AXIS, None), tp))(out)
             # has_variable is False exactly during .init(): create the cache
             # zeros but do NOT write/advance — init-time mutations persist
             # into the returned variables, which would hand `generate` a
@@ -235,13 +287,15 @@ class GPTBlock(nn.Module):
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     partition_experts: bool = False
+    decode_slots: bool = False   # serving slot-table decode (see attention)
 
     @nn.compact
     def __call__(self, x, train: bool = False, pos=None):
         tp = self.partition_model
         y = CausalSelfAttention(self.hidden, self.heads, self.attention_impl,
                                 self.seq_axis, tp, self.decode, self.max_len,
-                                self.rope, self.kv_heads, self.dtype)(
+                                self.rope, self.kv_heads, self.dtype,
+                                decode_slots=self.decode_slots)(
                                     nn.LayerNorm(dtype=self.dtype)(x), pos)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
@@ -317,14 +371,26 @@ class GPTLM(nn.Module):
                                  # ppermutes replay symmetrically on every
                                  # seq device during recompute).
     dtype: jnp.dtype = jnp.float32
+    decode_slots: bool = False   # serving: the batch dim is a SLOT table
+                                 # (serving/kv_cache.py) — the caller passes
+                                 # per-slot ``positions`` and owns the
+                                 # length/active bookkeeping; one compiled
+                                 # decode step advances slots of any age
 
     causal_lm = True  # read by engines/harness to select the LM data layout
 
     @nn.compact
-    def __call__(self, token_ids, train: bool = False):
+    def __call__(self, token_ids, train: bool = False, positions=None):
         seq_parallel = self.attention_impl in ("ring", "ring_flash",
                                                "ulysses", "ulysses_flash")
         lq = token_ids.shape[1]
+        if self.decode_slots and not self.decode:
+            raise ValueError("decode_slots=True requires decode=True "
+                             "(slot serving is a KV-cache decode mode)")
+        if positions is not None and not self.decode_slots:
+            raise ValueError(
+                "positions is only accepted in decode_slots mode — every "
+                "other mode derives positions internally (cursor/offset)")
         if self.decode:
             if seq_parallel:
                 # the hard constraint: ring/ulysses run inside shard_map
@@ -341,15 +407,30 @@ class GPTLM(nn.Module):
                     "over 'seq'; a 1-token step has no sequence to shard); "
                     "clone with attention_impl='dense' — `generate` does "
                     "this.  partition_model decode IS supported (GSPMD).")
-            # the model-level cursor feeds the position embedding; each
-            # attention layer keeps its own cache cursor in lockstep.  Not
-            # advanced during .init() (same guard as the attention cache).
-            ready = self.has_variable("cache", "pos_index")
-            pcur = self.variable("cache", "pos_index",
-                                 lambda: jnp.zeros((), jnp.int32))
-            pos = pcur.value + jnp.arange(lq)[None, :]
-            if ready:
-                pcur.value = pcur.value + lq
+            if self.decode_slots:
+                # serving: per-slot positions come from the caller (the
+                # slot length vector) — there is no shared cursor because
+                # slots are at different depths by construction
+                if positions is None:
+                    raise ValueError(
+                        "decode_slots=True needs positions (B, L): the "
+                        "per-slot write index / position-embedding input")
+                if positions.shape != token_ids.shape:
+                    raise ValueError(
+                        f"positions shape {positions.shape} must match "
+                        f"token_ids shape {token_ids.shape}")
+                pos = positions
+            else:
+                # the model-level cursor feeds the position embedding; each
+                # attention layer keeps its own cache cursor in lockstep.
+                # Not advanced during .init() (same guard as the attention
+                # cache).
+                ready = self.has_variable("cache", "pos_index")
+                pcur = self.variable("cache", "pos_index",
+                                     lambda: jnp.zeros((), jnp.int32))
+                pos = pcur.value + jnp.arange(lq)[None, :]
+                if ready:
+                    pcur.value = pcur.value + lq
         elif seq_parallel:
             if lq * coll.axis_size(self.seq_axis) > self.max_len:
                 raise ValueError(
@@ -397,14 +478,18 @@ class GPTLM(nn.Module):
         block_cls = (nn.remat(GPTBlock, static_argnums=(2,)) if self.remat
                      else GPTBlock)
         for i in range(self.layers):
+            # slot decode threads pos regardless of rope: the attention
+            # layer needs the per-slot write index, not just the rotation
             x = block_cls(self.hidden, self.heads, self.ffn,
                           self.dropout_rate, self.attention_impl,
                           self.seq_axis, self.partition_model,
                           self.decode, self.max_len, rope, self.kv_heads,
                           self.dtype, self.moe_experts, self.moe_top_k,
                           self.moe_capacity_factor, self.partition_experts,
-                          name=f"GPTBlock_{i}")(x, train,
-                                                pos if rope else None)
+                          decode_slots=self.decode_slots,
+                          name=f"GPTBlock_{i}")(
+                              x, train,
+                              pos if (rope or self.decode_slots) else None)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.tie_embeddings:
             # tied head: contraction against the (possibly vocab-sharded)

@@ -63,6 +63,11 @@ class NativeBatcher:
 
     Keeps the dataset arrays alive for the C++ side and reuses the staging
     buffers across epochs.  Not thread-safe; one consumer at a time.
+
+    Labels are SCALAR per row (the (batch,) int32 staging buffer below):
+    datasets with per-row label arrays — the LM next-token layout — must
+    use the Python path; ``Dataset.batches`` gates on ``y.ndim`` so the
+    C++ gather can never silently flatten (B, L) targets.
     """
 
     def __init__(self, x: np.ndarray, y: np.ndarray, batch_size: int,

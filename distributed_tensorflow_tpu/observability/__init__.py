@@ -38,7 +38,7 @@ to ``steps_per_call=1`` (see Trainer.resolve_steps_per_call).
 """
 
 from distributed_tensorflow_tpu.observability.report import (
-    build_run_report, runtime_environment)
+    build_run_report, runtime_environment, serve_section)
 from distributed_tensorflow_tpu.observability.sink import (
     SCHEMA_VERSION, AsyncJsonlSink)
 from distributed_tensorflow_tpu.observability.trace import (
@@ -52,6 +52,7 @@ __all__ = [
     "Tracer",
     "build_run_report",
     "runtime_environment",
+    "serve_section",
 ]
 
 

@@ -276,6 +276,17 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     ("mfu", "higher"),
     # health: anomaly count (flattened from the health section below)
     ("health_anomalies", "lower"),
+    # serving (bench --serve line / run report `serve` section, flattened
+    # below): latency percentiles gate lower-is-better — TTFT includes
+    # queue wait by the BASELINE.md accounting rule, so an admission
+    # regression shows up here, not just in throughput — and
+    # requests/sec/chip higher.  ITL/TTFT p50s compared too: a p95-only
+    # gate would let the median regress behind a stable tail.
+    ("serve_requests_per_sec_per_chip", "higher"),
+    ("serve_requests_per_sec", "higher"),
+    ("serve_tokens_per_sec", "higher"),
+    ("serve_ttft_p50_s", "lower"), ("serve_ttft_p95_s", "lower"),
+    ("serve_itl_p50_s", "lower"), ("serve_itl_p95_s", "lower"),
 )
 
 
@@ -312,6 +323,14 @@ def load_report(path: str | Path) -> dict[str, Any]:
     health = flat.get("health")
     if isinstance(health, dict) and "anomalies" in health:
         flat.setdefault("health_anomalies", health["anomalies"])
+    # a run report's nested `serve` section surfaces its serve_* metrics
+    # at the top level so serving runs diff with the same machinery as
+    # training runs (bench --serve lines already emit them flat)
+    serve = flat.get("serve")
+    if isinstance(serve, dict):
+        for key, value in serve.items():
+            if key.startswith("serve_"):
+                flat.setdefault(key, value)
     return flat
 
 
@@ -322,6 +341,11 @@ def _value_direction(report: dict[str, Any]) -> str:
     tokens/sec) higher.  Hard-coding 'higher' would invert the verdict
     the day a time-valued bench metric gains a headline value."""
     probe = f"{report.get('metric', '')} {report.get('unit', '')}".lower()
+    # rates first: "…_per_sec_per_chip" CONTAINS the substring "sec_per",
+    # so the time-per test alone misread every rate-valued bench line as
+    # lower-is-better (an examples/sec improvement diffed as a regression)
+    if any(s in probe for s in ("per_sec", "per sec", "/sec", "/s ")):
+        return "higher"
     if any(s in probe for s in ("_ms", " ms", "ms/", "_s ", "seconds_per",
                                 "sec_per", "s/step", "latency")):
         return "lower"

@@ -58,13 +58,34 @@ def runtime_environment() -> dict[str, Any]:
     return env
 
 
+def serve_section(summary: dict[str, Any] | None,
+                  n_devices: int = 1) -> dict[str, Any] | None:
+    """Normalize a ContinuousBatcher summary into the run-report/bench
+    ``serve`` section: the per-request result objects are dropped (the
+    section must stay JSON), and the per-chip throughput — THE gated
+    serving headline, mirroring examples_per_sec_per_device — is derived
+    here so every surface divides by the same device count."""
+    if summary is None:
+        return None
+    sec = {k: v for k, v in summary.items() if k != "results"}
+    rps = sec.get("serve_requests_per_sec")
+    sec["serve_requests_per_sec_per_chip"] = (
+        rps / n_devices if isinstance(rps, (int, float)) and n_devices
+        else None)
+    return sec
+
+
 def build_run_report(fit_result: dict[str, Any], *,
                      watchdog=None, metrics_logger=None, tracer=None,
+                     serve: dict[str, Any] | None = None,
                      ) -> dict[str, Any]:
     """Assemble the run report from the Trainer's fit result and the live
     telemetry objects.  Every argument except ``fit_result`` is optional —
     absent subsystems report as None, so readers can distinguish
-    "disabled" from "zero"."""
+    "disabled" from "zero".  ``serve`` is a post-training serving window's
+    section (``serve_section``) — serving gets the same trajectory and
+    regression gating training has (`analyze diff` flattens the nested
+    serve_* keys)."""
     st = fit_result.get("step_time") or {}
     elapsed = float(fit_result.get("elapsed") or 0.0)
 
@@ -133,6 +154,11 @@ def build_run_report(fit_result: dict[str, Any], *,
     # on): anomaly record + run maxima of the per-step stats.  None when
     # health was off — "disabled" stays distinguishable from "healthy".
     report["health"] = fit_result.get("health")
+
+    # serving window (--serve): requests/sec/chip + TTFT/ITL percentiles
+    # of the post-training continuous-batching run.  None when serving was
+    # off — the section, not its absence, is what `analyze diff` gates.
+    report["serve"] = serve
 
     overhead = 0.0
     if tracer is not None and tracer.enabled:

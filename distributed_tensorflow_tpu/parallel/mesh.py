@@ -153,6 +153,29 @@ def per_device_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     return NamedSharding(mesh, P(axis))
 
 
+def kv_slot_sharding(mesh: Mesh, ndim: int, *,
+                     shard_heads: bool = False,
+                     head_dim_index: int = 2) -> NamedSharding:
+    """Sharding for a serving KV-slot buffer (serving/kv_cache.py).
+
+    The canonical leaf is ``(slots, max_len, kv_heads, head_dim)``: the
+    slot dim splits over ``data`` (each data shard owns a contiguous block
+    of request slots — the serving analogue of batch parallelism), and
+    with ``shard_heads`` the kv-head dim additionally splits over
+    ``model`` (the tensor-parallel head layout of
+    engines/tensor_parallel.py, so a TP-trained model's cache lives where
+    its QKV projections already are).  ``ndim < head_dim_index + 1``
+    leaves (per-slot length/active vectors) shard the slot dim only.
+    Axes absent from the mesh replicate."""
+    spec = [None] * ndim
+    if ndim and DATA_AXIS in mesh.axis_names:
+        spec[0] = DATA_AXIS
+    if shard_heads and MODEL_AXIS in mesh.axis_names \
+            and ndim > head_dim_index:
+        spec[head_dim_index] = MODEL_AXIS
+    return NamedSharding(mesh, P(*spec))
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Declarative mesh request, resolvable on real TPUs or the CPU fake mesh."""

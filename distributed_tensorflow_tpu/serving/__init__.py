@@ -1,0 +1,24 @@
+"""Continuous-batching inference serving (ROADMAP item 1).
+
+Two halves:
+
+* ``kv_cache.SlotKVCache`` — the device half: a fixed slot table of KV
+  buffers sharded over the training mesh, one compiled single-token decode
+  step for the whole table, and a compiled per-bucket prefill-insert so
+  admission never recompiles decoding.
+* ``scheduler.ContinuousBatcher`` — the host half: an iteration-level
+  request scheduler (admit between decode steps, evict finished slots)
+  with MLPerf-style TTFT/ITL percentile accounting and per-request trace
+  spans through the existing observability stack.
+
+``bench.py --serve`` drives an open-loop arrival process through both and
+reports requests/sec/chip + latency percentiles; the harness's ``--serve``
+flag runs a post-training serving window whose summary lands in the run
+report, gated by ``analyze diff`` exactly like the training metrics.
+"""
+
+from distributed_tensorflow_tpu.serving.kv_cache import (  # noqa: F401
+    SlotKVCache, SlotOverflow)
+from distributed_tensorflow_tpu.serving.scheduler import (  # noqa: F401
+    ContinuousBatcher, Request, RequestQueue, RequestResult, VirtualClock,
+    WallClock)

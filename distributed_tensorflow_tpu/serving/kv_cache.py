@@ -1,0 +1,302 @@
+"""Slot-based sharded KV cache: the device half of the serving engine.
+
+Static batching idles the chip on every finished sequence — a batch of
+requests decodes at the pace of its longest member, and admitting a new
+request means restarting ``generate`` from scratch.  Continuous batching
+(Orca/vLLM-style in-flight batching) fixes that by making the *batch slot*,
+not the batch, the unit of scheduling: the KV cache is a fixed table of
+``slots`` independent sequences, each with its own length, and ONE compiled
+single-token decode step advances every active slot regardless of age.
+Admission and eviction are per-slot edits between decode iterations — the
+decode program never recompiles.
+
+Device-side contract (everything else lives in serving/scheduler.py):
+
+* the cache is a pytree of ``(slots, max_len, kv_heads, head_dim)`` leaves
+  (models/gpt.py slot-decode mode — deliberately no scalar cursors, so
+  every leaf shards the slot dim over the mesh's ``data`` axis and, for
+  tensor-parallel models, the kv-head dim over ``model``;
+  parallel/mesh.py ``kv_slot_sharding``);
+* ``advance`` is the one jitted decode step: (tokens, lengths, active)
+  vectors in, next tokens out, cache donated through;
+* ``insert`` is a jitted prefill that feeds a new request's prompt through
+  the SAME per-token decode math inside a ``lax.scan`` over the padded
+  prompt, against only that slot's cache slice (batch 1), then writes the
+  slice back — compiled once per padded length bucket (powers of two), so
+  steady-state admission never triggers XLA.
+
+Greedy slot decode is token-identical to the sequential ``generate``
+sampler per request (tests/test_serving.py): prefill-at-position-t and
+decode-at-cursor-t run the same dense cache attention with the same
+length-driven validity mask.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.models.gpt import GPTLM
+from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+
+def _bucket(n: int, floor: int, cap: int) -> int:
+    """Smallest power-of-two ≥ max(n, floor), capped at ``cap`` — the
+    padded prompt length, so prefill compiles once per bucket instead of
+    once per prompt length."""
+    b = max(int(floor), 1)
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class SlotOverflow(RuntimeError):
+    """An active slot was asked to write past its ``max_len`` capacity.
+
+    The scheduler guards admission (prompt + max_new_tokens ≤ max_len), so
+    reaching this means a bookkeeping bug, not a user error — the serving
+    twin of the training path's sticky cache-overflow flag (models/gpt.py
+    ADVICE r3: never silently clamp)."""
+
+
+class SlotKVCache:
+    """Fixed slot table + compiled prefill/decode programs for one GPTLM.
+
+    ``model`` is the TRAINING-mode module (any attention impl); it is
+    cloned into slot-decode mode exactly like ``generate`` clones into
+    cursor-decode mode — dense cache attention, dropout off, Megatron TP
+    layout kept when ``mesh`` has a 'model' axis and the model was
+    partitioned.  ``params`` may be a TP engine's committed TrainState
+    params (used in place) or host/single-device params (replicated).
+
+    Host-side bookkeeping (`lengths`, `active`, `tokens`) lives on numpy:
+    the scheduler owns admission/eviction and the decode step receives the
+    vectors as arguments, so slot edits never touch device state except
+    through the two compiled programs.
+    """
+
+    def __init__(self, model: GPTLM, params, slots: int, *,
+                 mesh=None, greedy: bool = True, temperature: float = 1.0,
+                 prefill_bucket: int = 8, rng=None):
+        if slots < 1:
+            raise ValueError(f"slots must be positive, got {slots}")
+        self.slots = int(slots)
+        self.max_len = int(model.max_len)
+        self.greedy = bool(greedy)
+        self.temperature = float(temperature)
+        self.prefill_bucket = int(prefill_bucket)
+        self.mesh = mesh
+        keep_tp = (mesh is not None and model.partition_model
+                   and meshlib.MODEL_AXIS in mesh.axis_names)
+        self.dm = model.clone(decode=True, decode_slots=True,
+                              attention_impl="dense",
+                              partition_model=keep_tp, dropout_rate=0.0)
+        self._rng = rng if rng is not None else jax.random.key(0)
+
+        # zero slot cache from an abstract init — zeros-from-shape IS the
+        # init value (same argument as models/gpt.py `generate`)
+        dummy = jnp.zeros((self.slots, 1), jnp.int32)
+        shapes = jax.eval_shape(
+            lambda: self.dm.init(jax.random.key(0), dummy, train=False,
+                                 positions=dummy))["cache"]
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+        self._vec_sharding = None
+        if mesh is not None:
+            dp = mesh.shape.get(meshlib.DATA_AXIS, 1)
+            if self.slots % dp:
+                raise ValueError(
+                    f"slots ({self.slots}) must divide by the mesh's data "
+                    f"axis ({dp}): each data shard owns a contiguous slot "
+                    f"block")
+            cache = jax.tree.map(
+                lambda t: jax.device_put(t, meshlib.kv_slot_sharding(
+                    mesh, t.ndim, shard_heads=keep_tp)), cache)
+            self._vec_sharding = meshlib.kv_slot_sharding(mesh, 1)
+            # params committed to this mesh are used in place; anything
+            # else replicates (the `generate(mesh=...)` placement rule)
+            repl = NamedSharding(mesh, P())
+            target = mesh.devices.tolist()
+
+            def place(t):
+                sh = getattr(t, "sharding", None)
+                if isinstance(sh, NamedSharding) and (
+                        sh.mesh is mesh
+                        or sh.mesh.devices.tolist() == target):
+                    return t
+                return jax.device_put(t, repl)
+
+            params = jax.tree.map(place, params)
+        self.cache = cache
+        self.params = params
+
+        # host-side slot table
+        self.lengths = np.zeros(self.slots, np.int32)
+        self.active = np.zeros(self.slots, np.bool_)
+        self.tokens = np.zeros(self.slots, np.int32)   # last token per slot
+
+        self._step = self._build_step()
+        self._prefills: dict[int, object] = {}
+
+    # ------------------------------------------------------------- programs
+    def _sample(self, logits, rng):
+        """(B, V) logits → (B,) token ids; greedy or temperature draw —
+        the ONE sampling definition shared by prefill and decode."""
+        if self.greedy:
+            return logits.argmax(-1)
+        return jax.random.categorical(
+            rng, logits / max(self.temperature, 1e-6))
+
+    def _build_step(self):
+        dm = self.dm
+
+        def step(params, cache, tokens, lengths, active, rng):
+            # write index = current length; inactive (free) slots scatter
+            # garbage into their own rows only, which the next insert's
+            # prefill overwrites — validity is length-driven, so stale
+            # positions are never attended
+            logits, upd = dm.apply(
+                {"params": params, "cache": cache}, tokens[:, None],
+                train=False, positions=lengths[:, None], mutable=["cache"])
+            nxt = self._sample(logits[:, -1], rng).astype(tokens.dtype)
+            return upd["cache"], jnp.where(active, nxt, tokens)
+
+        return jax.jit(step, donate_argnums=1)
+
+    def _prefill(self, lpad: int):
+        """Compiled prefill-insert for one padded prompt length.
+
+        Slices slot ``slot`` out of every cache leaf, scans the padded
+        prompt through the single-token slot-decode step (batch 1,
+        positions 0..lpad-1), writes the slice back, and samples the FIRST
+        generated token from the logits at the last REAL prompt position.
+        Steps past ``prompt_len`` write garbage K/V beyond the slot's
+        length — invisible under the length mask and overwritten as
+        decoding advances (the same argument that makes free-slot scatter
+        writes safe).  The decode step is untouched: admission never
+        recompiles it."""
+        dm = self.dm
+
+        def prefill(params, cache, slot, tokens, prompt_len, rng):
+            sub = jax.tree.map(
+                lambda t: lax.dynamic_slice_in_dim(t, slot, 1, 0), cache)
+
+            def body(c, xs):
+                tok, t = xs
+                logits, upd = dm.apply(
+                    {"params": params, "cache": c}, tok[None, None],
+                    train=False, positions=t[None, None],
+                    mutable=["cache"])
+                return upd["cache"], logits[0, -1]
+
+            sub, all_logits = lax.scan(
+                body, sub, (tokens, jnp.arange(lpad, dtype=jnp.int32)))
+            last = jnp.take(all_logits, prompt_len - 1, axis=0)
+            first = self._sample(last[None, :], rng)[0]
+            cache = jax.tree.map(
+                lambda full, s: lax.dynamic_update_slice_in_dim(
+                    full, s, slot, 0), cache, sub)
+            return cache, first.astype(tokens.dtype)
+
+        return jax.jit(prefill, donate_argnums=1)
+
+    # ------------------------------------------------------------ slot API
+    @property
+    def free_slots(self) -> list[int]:
+        return [i for i in range(self.slots) if not self.active[i]]
+
+    def _put_vec(self, arr):
+        arr = jnp.asarray(arr)
+        if self._vec_sharding is not None:
+            arr = jax.device_put(arr, self._vec_sharding)
+        return arr
+
+    def _put_repl(self, arr):
+        """Replicated placement: the padded prompt is per-scan-step data,
+        not a (slots,) vector — slot sharding would demand the padded
+        length divide the data axis (it usually won't)."""
+        arr = jnp.asarray(arr)
+        if self.mesh is not None:
+            arr = jax.device_put(arr, NamedSharding(self.mesh, P()))
+        return arr
+
+    def _next_rng(self):
+        if self.greedy:
+            return self._rng  # unused by the program; keep it static
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def insert(self, prompt, slot: int | None = None) -> tuple[int, int]:
+        """Admit a prompt into a free slot (jitted prefill-insert).
+
+        Returns ``(slot, first_token)`` — the first generated token is
+        sampled by the prefill itself (its wall time IS the time-to-first-
+        token), and the slot's length becomes ``len(prompt)``: the first
+        decode step will write the returned token's K/V at that position.
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        lp = int(prompt.shape[0])
+        if lp < 1:
+            raise ValueError("prompt must hold at least one token")
+        if lp >= self.max_len:
+            raise ValueError(
+                f"prompt length {lp} leaves no room to generate within "
+                f"max_len={self.max_len}")
+        if slot is None:
+            free = self.free_slots
+            if not free:
+                raise RuntimeError("no free slot — evict before inserting")
+            slot = free[0]
+        elif self.active[slot]:
+            raise RuntimeError(f"slot {slot} is active — evict it first")
+        lpad = _bucket(lp, self.prefill_bucket, self.max_len)
+        padded = np.zeros(lpad, np.int32)
+        padded[:lp] = prompt
+        if lpad not in self._prefills:
+            self._prefills[lpad] = self._prefill(lpad)
+        fn = self._prefills[lpad]
+        self.cache, first = fn(
+            self.params, self.cache, jnp.int32(slot),
+            self._put_repl(padded), jnp.int32(lp), self._next_rng())
+        self.active[slot] = True
+        self.lengths[slot] = lp
+        self.tokens[slot] = first = int(first)
+        return slot, first
+
+    def advance(self) -> np.ndarray:
+        """One decode iteration: every ACTIVE slot consumes its last token
+        and emits the next one; lengths advance by one.  Returns the
+        (slots,) token vector — inactive rows carry their stale token.
+        The jitted step is compiled exactly once per cache shape."""
+        live = self.lengths[self.active]
+        if live.size and int(live.max()) >= self.max_len:
+            raise SlotOverflow(
+                f"active slot at length {int(live.max())} would write past "
+                f"max_len={self.max_len}; the scheduler must bound "
+                f"prompt + max_new_tokens at admission")
+        self.cache, nxt = self._step(
+            self.params, self.cache, self._put_vec(self.tokens),
+            self._put_vec(self.lengths),
+            self._put_vec(self.active), self._next_rng())
+        nxt = np.asarray(nxt)
+        self.lengths[self.active] += 1
+        self.tokens = nxt.astype(np.int32)
+        return nxt
+
+    def evict(self, slot: int) -> None:
+        """Free a slot.  Pure host bookkeeping: stale K/V stays in the
+        buffer but is unreachable (validity is length-driven) and the next
+        insert's prefill overwrites it from position 0."""
+        if not self.active[slot]:
+            raise RuntimeError(f"slot {slot} is not active")
+        self.active[slot] = False
+        self.lengths[slot] = 0
+        self.tokens[slot] = 0
+
+    def compiled_programs(self) -> dict[str, int]:
+        """{decode_steps: 1, prefill_buckets: N} — the recompile-freedom
+        invariant the tests pin down."""
+        return {"decode_steps": 1, "prefill_buckets": len(self._prefills)}

@@ -1,0 +1,350 @@
+"""Continuous batcher: the request-scheduling half of the serving engine.
+
+The loop is the Orca/vLLM iteration-level scheduler shape: between decode
+iterations it (a) admits arrived requests into free KV slots (jitted
+prefill-insert, never recompiling the decode step), (b) runs ONE decode
+iteration over the whole slot table, and (c) evicts finished slots so the
+next arrivals claim them mid-flight.  ``mode='static'`` degrades the same
+loop to the restart-per-batch ``generate`` baseline — admission only when
+the table is empty — so continuous-vs-static comparisons share every line
+of device code and the decode-iteration counter is directly comparable.
+
+The request queue rebuilds the claim discipline of the unwired native
+batch pipeline (native/batcher.py): one consumer claims the queue for a
+run and releases it deterministically on exit, so two schedulers can never
+interleave admissions from the same queue (the _EpochIterator busy-claim
+contract, rebuilt in Python because requests arrive one at a time rather
+than as a C++ epoch cursor).
+
+Latency accounting follows the MLPerf inference convention (Mattson et
+al., arXiv:1910.01500 — latency percentiles as machine-checked numbers):
+TTFT is arrival→first-token (queue wait INCLUDED — an admitted-late
+request is a slow request), ITL is the gap between consecutive token
+deliveries, and both report p50/p95 over the whole run.  Every request
+emits ``request``/``prefill``/``decode`` trace spans through the existing
+observability stack, so `analyze spans` and the Perfetto export read
+serving timelines with no new machinery.
+
+Clocks are injectable: ``WallClock`` (real time; idle waits sleep until
+the next arrival — the open-loop bench) or ``VirtualClock`` (time = decode
+iterations; deterministic staggered-arrival tests).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+from distributed_tensorflow_tpu.observability.trace import NULL_TRACER
+from distributed_tensorflow_tpu.serving.kv_cache import SlotKVCache
+
+
+# ------------------------------------------------------------------ clocks
+
+class WallClock:
+    """Real time: arrivals are seconds since ``start()``; idle waits sleep."""
+
+    def __init__(self):
+        self._t0 = None
+
+    def start(self) -> None:
+        self._t0 = time.monotonic()
+
+    def now(self) -> float:
+        return time.monotonic() - self._t0
+
+    def on_decode_iteration(self) -> None:
+        pass  # real time advances itself
+
+    def wait_until(self, t: float) -> None:
+        delta = t - self.now()
+        if delta > 0:
+            time.sleep(delta)
+
+
+class VirtualClock:
+    """Deterministic time: one decode iteration = ``tick`` time units.
+
+    Arrival times are then expressed in decode iterations, which makes
+    "request arrives mid-decode" an exact, repeatable event — the
+    staggered-arrival acceptance tests run on this clock."""
+
+    def __init__(self, tick: float = 1.0):
+        self.t = 0.0
+        self.tick = float(tick)
+
+    def start(self) -> None:
+        self.t = 0.0
+
+    def now(self) -> float:
+        return self.t
+
+    def on_decode_iteration(self) -> None:
+        self.t += self.tick
+
+    def wait_until(self, t: float) -> None:
+        self.t = max(self.t, t)
+
+
+# ----------------------------------------------------------------- request
+
+@dataclasses.dataclass
+class Request:
+    """One serving request of the open-loop arrival process."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    arrival_s: float = 0.0
+    eos_id: int | None = None
+
+
+class RequestQueue:
+    """Arrival-ordered queue with the native batcher's busy-claim contract
+    (native/batcher.py: one consumer owns the cursor; release is
+    deterministic, not GC-time).  ``claim()`` returns a context manager —
+    a second concurrent scheduler on the same queue raises instead of
+    silently interleaving admissions."""
+
+    def __init__(self, requests: Iterable[Request] = ()):
+        self._items: list[Request] = sorted(
+            requests, key=lambda r: (r.arrival_s, r.rid))
+        self.busy = False
+
+    def push(self, request: Request) -> None:
+        self._items.append(request)
+        self._items.sort(key=lambda r: (r.arrival_s, r.rid))
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def next_arrival(self) -> float | None:
+        return self._items[0].arrival_s if self._items else None
+
+    def pop_ready(self, now: float) -> Request | None:
+        if self._items and self._items[0].arrival_s <= now:
+            return self._items.pop(0)
+        return None
+
+    @contextlib.contextmanager
+    def claim(self):
+        if self.busy:
+            raise RuntimeError(
+                "RequestQueue is busy: another scheduler run owns it "
+                "(the native/batcher.py single-consumer claim contract)")
+        self.busy = True
+        try:
+            yield self
+        finally:
+            self.busy = False
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Per-request outcome + latency timeline (clock units)."""
+
+    rid: int
+    prompt_len: int
+    tokens: list[int]
+    arrival_s: float
+    admitted_s: float
+    first_token_s: float
+    finished_s: float = 0.0
+    itl_s: list[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def ttft_s(self) -> float:
+        return self.first_token_s - self.arrival_s
+
+
+class _Live:
+    """Host bookkeeping for one in-flight slot."""
+
+    def __init__(self, req: Request, result: RequestResult,
+                 req_span, dec_span, last_t: float):
+        self.req = req
+        self.result = result
+        self.req_span = req_span     # entered context managers, exited on
+        self.dec_span = dec_span     # finish (per-request span contract)
+        self.last_t = last_t
+
+
+def _percentile(vals: list[float], q: float) -> float | None:
+    """Linear-interpolated percentile (stdlib-only math so the summary is
+    recomputable anywhere the JSONL lands)."""
+    if not vals:
+        return None
+    s = sorted(vals)
+    if len(s) == 1:
+        return s[0]
+    pos = (len(s) - 1) * q
+    lo = int(pos)
+    hi = min(lo + 1, len(s) - 1)
+    return s[lo] + (s[hi] - s[lo]) * (pos - lo)
+
+
+# --------------------------------------------------------------- batcher
+
+class ContinuousBatcher:
+    """In-flight request scheduler over a SlotKVCache (module docstring).
+
+    ``mode='continuous'`` admits between decode iterations (the tentpole
+    path); ``mode='static'`` only admits into an EMPTY slot table — the
+    restart-per-batch ``generate`` baseline, measured with the same
+    counters so the comparison is apples-to-apples.
+    """
+
+    def __init__(self, kv: SlotKVCache, *, tracer=NULL_TRACER,
+                 clock=None, mode: str = "continuous"):
+        if mode not in ("continuous", "static"):
+            raise ValueError(f"mode must be continuous|static, got {mode}")
+        self.kv = kv
+        self.tracer = tracer
+        self.clock = clock if clock is not None else WallClock()
+        self.mode = mode
+
+    # ------------------------------------------------------------ admission
+    def _admit(self, req: Request, live: dict[int, _Live]) -> int:
+        kv, tracer = self.kv, self.tracer
+        lp = int(np.asarray(req.prompt).reshape(-1).shape[0])
+        if lp + req.max_new_tokens > kv.max_len:
+            raise ValueError(
+                f"request {req.rid}: prompt ({lp}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds the slot capacity "
+                f"max_len={kv.max_len}")
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"request {req.rid}: max_new_tokens must be positive")
+        req_span = tracer.span("request", rid=req.rid, prompt_len=lp,
+                               max_new_tokens=req.max_new_tokens)
+        req_span.__enter__()
+        with tracer.span("prefill", rid=req.rid, prompt_len=lp):
+            slot, first = kv.insert(req.prompt)
+        now = self.clock.now()
+        result = RequestResult(
+            rid=req.rid, prompt_len=lp, tokens=[first],
+            arrival_s=req.arrival_s, admitted_s=now, first_token_s=now)
+        dec_span = tracer.span("decode", rid=req.rid, slot=slot)
+        dec_span.__enter__()
+        live[slot] = _Live(req, result, req_span, dec_span, now)
+        if self._finished(live[slot]):
+            # max_new_tokens == 1 (or instant EOS): the prefill's token was
+            # the whole continuation — finish without a decode iteration
+            self._finish(slot, live)
+        return first
+
+    def _finished(self, lv: _Live) -> bool:
+        if len(lv.result.tokens) >= lv.req.max_new_tokens:
+            return True
+        eos = lv.req.eos_id
+        return eos is not None and lv.result.tokens[-1] == eos
+
+    def _finish(self, slot: int, live: dict[int, _Live]) -> None:
+        lv = live.pop(slot)
+        lv.result.finished_s = self.clock.now()
+        lv.dec_span.__exit__(None, None, None)
+        lv.req_span.__exit__(None, None, None)
+        self.kv.evict(slot)
+        self._results.append(lv.result)
+
+    # ------------------------------------------------------------- the loop
+    def _serve(self, queue: RequestQueue, live: dict[int, _Live],
+               on_token: Callable[[int, int], None] | None,
+               ) -> tuple[int, int]:
+        """The iteration loop under run()'s claim + cleanup guard; returns
+        (decode_iterations, prefills)."""
+        kv, tracer, clock = self.kv, self.tracer, self.clock
+        decode_iterations = 0
+        prefills = 0
+        while len(queue) or live:
+            # admission between decode iterations: continuous mode
+            # fills any free slot from the arrived queue; static mode
+            # waits for the whole table to drain first
+            can_admit = self.mode == "continuous" or not live
+            while can_admit and kv.free_slots:
+                req = queue.pop_ready(clock.now())
+                if req is None:
+                    break
+                first = self._admit(req, live)
+                prefills += 1
+                if on_token is not None:
+                    on_token(req.rid, first)  # the prefill's own token
+            if not live:
+                nxt = queue.next_arrival()
+                if nxt is None:
+                    break
+                clock.wait_until(nxt)  # idle: jump/sleep to the arrival
+                continue
+            with tracer.span("decode_step", active=len(live)):
+                toks = kv.advance()
+            decode_iterations += 1
+            clock.on_decode_iteration()
+            now = clock.now()
+            for slot in sorted(live):
+                lv = live[slot]
+                tok = int(toks[slot])
+                lv.result.tokens.append(tok)
+                lv.result.itl_s.append(now - lv.last_t)
+                lv.last_t = now
+                if on_token is not None:
+                    on_token(lv.req.rid, tok)
+                if self._finished(lv):
+                    self._finish(slot, live)
+        return decode_iterations, prefills
+
+    def run(self, requests: Iterable[Request] | RequestQueue,
+            on_token: Callable[[int, int], None] | None = None,
+            ) -> dict[str, Any]:
+        """Serve every request to completion; returns the summary dict
+        (per-request results under ``results``).  ``on_token(rid, token)``
+        is the streaming hook — called at each token's host delivery."""
+        queue = (requests if isinstance(requests, RequestQueue)
+                 else RequestQueue(requests))
+        self._results: list[RequestResult] = []
+        live: dict[int, _Live] = {}
+        with queue.claim():
+            self.clock.start()
+            t_start = self.clock.now()
+            try:
+                decode_iterations, prefills = self._serve(queue, live,
+                                                          on_token)
+            except BaseException:
+                # a failed window must not poison the slot table — bench
+                # windows share ONE SlotKVCache, and a leaked active slot
+                # shrinks every later window's capacity (zero free slots
+                # + zero live = a busy-spin).  Free the in-flight slots
+                # and close their spans so the records written so far
+                # survive into the partial-results artifact.
+                for slot in sorted(live):
+                    lv = live.pop(slot)
+                    lv.dec_span.__exit__(None, None, None)
+                    lv.req_span.__exit__(None, None, None)
+                    self.kv.evict(slot)
+                raise
+            elapsed = self.clock.now() - t_start
+        results = sorted(self._results, key=lambda r: r.rid)
+        ttfts = [r.ttft_s for r in results]
+        itls = [g for r in results for g in r.itl_s]
+        tokens = sum(len(r.tokens) for r in results)
+        return {
+            "mode": self.mode,
+            "requests": len(results),
+            "completed": len(results),
+            "decode_iterations": decode_iterations,
+            "prefills": prefills,
+            "tokens_generated": tokens,
+            "elapsed_s": elapsed,
+            "serve_requests_per_sec": (len(results) / elapsed
+                                       if elapsed > 0 else None),
+            "serve_tokens_per_sec": (tokens / elapsed
+                                     if elapsed > 0 else None),
+            "serve_ttft_p50_s": _percentile(ttfts, 0.50),
+            "serve_ttft_p95_s": _percentile(ttfts, 0.95),
+            "serve_itl_p50_s": _percentile(itls, 0.50),
+            "serve_itl_p95_s": _percentile(itls, 0.95),
+            "results": results,
+        }

@@ -177,6 +177,24 @@ class ExperimentConfig:
                                            # and record them in the summary
     sample_prompt_len: int = 8             # prompt tokens taken from the
                                            # test split per sampled row
+    serve_requests: int = 0                # >0: after training an LM, run a
+                                           # continuous-batching serving
+                                           # window of this many requests
+                                           # (serving/: slot KV cache +
+                                           # in-flight scheduler) and carry
+                                           # its TTFT/ITL percentiles +
+                                           # requests/sec/chip in the
+                                           # summary and run report —
+                                           # serving gets the same
+                                           # trajectory and `analyze diff`
+                                           # gating training has
+    serve_slots: int = 4                   # KV slot table size (requests in
+                                           # flight at once; shards over
+                                           # the 'data' axis when it
+                                           # divides)
+    serve_max_new: int = 16                # tokens generated per request
+    serve_prompt_len: int = 8              # prompt tokens taken from the
+                                           # test split per request
 
 
 def enable_compile_cache(directory: str | os.PathLike) -> str:
@@ -1312,6 +1330,10 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     global_batch = ex.global_batch
     if config.sample_tokens:
         _validate_sampling(config, ex, test_ds)
+    if config.serve_requests:
+        # like sampling: every deterministically-knowable --serve failure
+        # raises BEFORE the run spends a training budget on it
+        _validate_serving(config, ex, test_ds)
 
     # in a multi-host pod only process 0 reports — N processes each emitting
     # the start/done/results triple would corrupt an external supervisor's
@@ -1495,6 +1517,11 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         if config.sample_tokens:
             summary.update(_sample_from_state(config, ex, trainer.state,
                                               test_ds))
+        serve_sec = None
+        if config.serve_requests:
+            serve_sec = _serve_from_state(config, ex, trainer.state,
+                                          test_ds, tracer, total_devices)
+            summary["serve"] = serve_sec
         # end-of-run report: steady-state percentiles split from compile,
         # chunk shapes actually used, watchdog/prefetch/sink health, and
         # the telemetry's own measured overhead (observability/report) —
@@ -1505,7 +1532,7 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
             metrics_logger.flush()
         report = build_run_report(fit, watchdog=watchdog,
                                   metrics_logger=metrics_logger,
-                                  tracer=tracer)
+                                  tracer=tracer, serve=serve_sec)
         summary["run_report"] = report
         sink.emit("run_report", **report)
         sink.emit("summary", **summary)
@@ -1664,6 +1691,89 @@ def _sample_from_state(config: ExperimentConfig, ex: _Experiment, state,
         "sample_prompts": prompts.tolist(),
         "samples": toks.tolist(),
     }
+
+
+def _validate_serving(config: ExperimentConfig, ex: _Experiment,
+                      test_ds) -> None:
+    """Pre-train validation of the --serve window (same contract as
+    _validate_sampling: a post-train raise would waste the whole run and,
+    under --max-restarts, re-train to fail identically)."""
+    from distributed_tensorflow_tpu.models.gpt import GPTLM
+
+    if config.serve_requests < 0:
+        raise ValueError(
+            f"--serve must be positive, got {config.serve_requests}")
+    if config.serve_slots < 1:
+        raise ValueError(
+            f"--serve-slots must be positive, got {config.serve_slots}")
+    if config.serve_max_new < 1:
+        raise ValueError(
+            f"--serve-max-new must be positive, got {config.serve_max_new}")
+    if _is_pipeline(ex.engine):
+        raise ValueError(
+            "--serve needs flat GPTLM params for the slot KV cache; a "
+            "pipeline engine's stage params are pipe-stacked — train "
+            "without -pp (or restore the checkpoint into a non-pipeline "
+            "layout) to serve")
+    model = ex.engine.model
+    if not isinstance(model, GPTLM):
+        raise ValueError(
+            f"--serve requires the GPT causal LM; the resolved model is "
+            f"{type(model).__name__}")
+    plen = config.serve_prompt_len
+    if plen < 1 or plen > test_ds.x.shape[1]:
+        raise ValueError(
+            f"--serve-prompt-len {plen} outside the test sequences' "
+            f"length {test_ds.x.shape[1]}")
+    if plen + config.serve_max_new > model.max_len:
+        raise ValueError(
+            f"--serve-prompt-len {plen} + --serve-max-new "
+            f"{config.serve_max_new} exceeds the model's capacity "
+            f"max_len={model.max_len}")
+
+
+def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
+                      test_ds, tracer, total_devices: int) -> dict[str, Any]:
+    """--serve N: run a continuous-batching serving window over the
+    trained params (serving/SlotKVCache + ContinuousBatcher) and return
+    the run report's ``serve`` section.
+
+    Prompts are test-split rows (``--serve-prompt-len`` tokens each,
+    wrapping when N exceeds the split); arrivals are all-at-zero under the
+    wall clock, so with N > slots the queue drains continuously as slots
+    free — admission, eviction and queue wait are all exercised without
+    sleeping, and TTFT percentiles include the queue time (BASELINE.md
+    rule).  The slot table rides the run's mesh when its axes are the
+    GSPMD serving set ({data, model}) and the slot count divides the data
+    axis; otherwise it serves replicated.  Greedy decode: like --sample,
+    the recorded window is a deterministic function of the final params.
+    Engines whose state stacks per-device copies (async/gossip) serve
+    their consensus ``eval_params``, same as evaluation and sampling."""
+    from distributed_tensorflow_tpu.observability import serve_section
+    from distributed_tensorflow_tpu.serving import (
+        ContinuousBatcher, Request, SlotKVCache)
+
+    get_params = getattr(ex.engine, "eval_params", None)
+    params = get_params(state) if get_params is not None else state.params
+    mesh = None
+    if (ex.mesh.devices.size > 1
+            and set(ex.mesh.axis_names) <= {meshlib.DATA_AXIS,
+                                            meshlib.MODEL_AXIS}
+            and config.serve_slots
+            % ex.mesh.shape.get(meshlib.DATA_AXIS, 1) == 0):
+        mesh = ex.mesh
+    kv = SlotKVCache(ex.engine.model, params, config.serve_slots,
+                     mesh=mesh)
+    rows = np.asarray(test_ds.x, np.int32)
+    plen = config.serve_prompt_len
+    requests = [
+        Request(rid=i, prompt=rows[i % len(rows), :plen],
+                max_new_tokens=config.serve_max_new, arrival_s=0.0)
+        for i in range(config.serve_requests)]
+    with tracer.span("serve", requests=config.serve_requests,
+                     slots=config.serve_slots):
+        summary = ContinuousBatcher(kv, tracer=tracer).run(requests)
+    return serve_section(summary, total_devices)
 
 
 def steps_to_accuracy(
