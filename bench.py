@@ -293,15 +293,35 @@ def _bench_checkpointing(fit_kw: dict, checkpoint_every: int):
 # default mode: training throughput + MFU
 # ---------------------------------------------------------------------------
 
+def _bench_model_and_engine(ds, mesh, grad_compression: str,
+                            grad_bucket_mb: float, precision: str):
+    """Model + SyncEngine of the training benches, precision-policy
+    aware: a non-f32 ``--precision`` builds the model at the policy's
+    compute dtype (the same dtype-follows-policy rule as the harness)
+    and threads the policy into the engine — param storage, optimizer
+    layout and the emitted bytes keys all reflect it."""
+    from distributed_tensorflow_tpu.engines import SyncEngine
+    from distributed_tensorflow_tpu.models import create_model
+    from distributed_tensorflow_tpu.parallel import precision as precisionlib
+
+    policy = precisionlib.make_policy(precision)
+    kw = {}
+    if policy.active:
+        kw["dtype"] = policy.compute_dtype
+    model = create_model("cnn", num_classes=ds.num_classes, **kw)
+    eng = SyncEngine(model, mesh=mesh, grad_compression=grad_compression,
+                     grad_bucket_mb=grad_bucket_mb, precision=precision)
+    return model, eng
+
+
 def bench_throughput(grad_compression: str = "none",
                      health: str = "off",
                      checkpoint_every: int = 0,
-                     grad_bucket_mb: float = 0.0) -> None:
+                     grad_bucket_mb: float = 0.0,
+                     precision: str = "f32") -> None:
     import jax
 
     from distributed_tensorflow_tpu.data.loaders import load_dataset
-    from distributed_tensorflow_tpu.engines import SyncEngine
-    from distributed_tensorflow_tpu.models import create_model
     from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
     # the first real device touch — where a transiently wedged lease
@@ -315,13 +335,13 @@ def bench_throughput(grad_compression: str = "none",
     global_batch = PER_CHIP_BATCH * n
 
     ds = load_dataset("mnist", split="train")
-    # measured f32 here: for this small CNN (1 input channel, 28×28) the
-    # bf16 cast overhead outweighs MXU-rate gains — 1.73M vs 2.19M ex/s/chip
-    # on v5e.  bf16 mixed precision remains available via --dtype bfloat16
-    # and wins on transformer-scale matmuls (see tests/test_models.py).
-    model = create_model("cnn", num_classes=ds.num_classes)
-    eng = SyncEngine(model, mesh=mesh, grad_compression=grad_compression,
-                     grad_bucket_mb=grad_bucket_mb)
+    # measured f32 by default: for this small CNN (1 input channel, 28×28)
+    # the bf16 cast overhead outweighs MXU-rate gains — 1.73M vs 2.19M
+    # ex/s/chip on v5e.  --precision bf16/bf16-f32master switches the
+    # whole stack (storage + compute + reduce) and the line reports the
+    # policy + per-device bytes so the trajectory stays attributable.
+    model, eng = _bench_model_and_engine(ds, mesh, grad_compression,
+                                         grad_bucket_mb, precision)
     if health == "on":
         # before init_state: the optimizer tree gains its capture slots
         eng.enable_health()
@@ -512,6 +532,12 @@ def bench_throughput(grad_compression: str = "none",
         "grad_bytes_per_step_wire": eng.grad_collective_bytes(state),
         "grad_bytes_per_step_raw": eng.grad_collective_bytes_raw(state),
         "grad_compression": eng.grad_codec.name,
+        # mixed-precision attribution (--precision): the active policy +
+        # the per-device state footprint it moves — environment-
+        # attribution style, like the jax_version keys below
+        "precision": eng.precision.name,
+        "param_bytes_per_device": eng.param_bytes_per_device(state),
+        "opt_state_bytes_per_device": eng.opt_state_bytes_per_device(state),
         # communication/compute overlap (--grad-bucket-mb): exposed
         # collective seconds still on the critical path vs hidden behind
         # compute (parallel/overlap.py probe; exposed is the `analyze
@@ -542,7 +568,7 @@ def bench_throughput(grad_compression: str = "none",
         "device": device_kind,
         "n_devices": n,
         "global_batch": global_batch,
-        "dtype": "float32",
+        "dtype": str(np.dtype(getattr(model, "dtype", np.float32))),
         "synthetic": bool(ds.synthetic),
         # attribution (the r03–r05 lesson): which toolchain/flags made
         # these numbers — diffable across containers
@@ -564,7 +590,8 @@ def bench_throughput(grad_compression: str = "none",
 
 def bench_stream(steps: int = 100, grad_compression: str = "none",
                  health: str = "off", checkpoint_every: int = 0,
-                 grad_bucket_mb: float = 0.0) -> None:
+                 grad_bucket_mb: float = 0.0,
+                 precision: str = "f32") -> None:
     """Training throughput when every step consumes a FRESH host batch —
     the configuration the C++ prefetcher (native/src/pipeline.cc) exists
     for.  'resident' (one device batch reused, the default bench) bounds the
@@ -572,8 +599,6 @@ def bench_stream(steps: int = 100, grad_compression: str = "none",
     import jax
 
     from distributed_tensorflow_tpu.data.loaders import load_dataset
-    from distributed_tensorflow_tpu.engines import SyncEngine
-    from distributed_tensorflow_tpu.models import create_model
     from distributed_tensorflow_tpu.native import load as native_load
     from distributed_tensorflow_tpu.parallel import mesh as meshlib
 
@@ -582,9 +607,8 @@ def bench_stream(steps: int = 100, grad_compression: str = "none",
     global_batch = PER_CHIP_BATCH * n
 
     ds = load_dataset("mnist", split="train")
-    model = create_model("cnn", num_classes=ds.num_classes)
-    eng = SyncEngine(model, mesh=mesh, grad_compression=grad_compression,
-                     grad_bucket_mb=grad_bucket_mb)
+    _model, eng = _bench_model_and_engine(ds, mesh, grad_compression,
+                                          grad_bucket_mb, precision)
     if health == "on":
         eng.enable_health()  # before init_state: capture slots in tx.init
 
@@ -705,6 +729,11 @@ def bench_stream(steps: int = 100, grad_compression: str = "none",
         "grad_bytes_per_step_wire": eng.grad_collective_bytes(state),
         "grad_bytes_per_step_raw": eng.grad_collective_bytes_raw(state),
         "grad_compression": eng.grad_codec.name,
+        # mixed-precision attribution (--precision), environment-
+        # attribution style like jax_version below
+        "precision": eng.precision.name,
+        "param_bytes_per_device": eng.param_bytes_per_device(state),
+        "opt_state_bytes_per_device": eng.opt_state_bytes_per_device(state),
         **({"checkpoint_every": checkpoint_every,
             "checkpoint_wait_s": trainer_fit.get("checkpoint_wait_s"),
             "checkpoint_overlapped_s":
@@ -1367,6 +1396,15 @@ def main() -> None:
                         "training benches (parallel/compression.py); the "
                         "JSON line reports grad_bytes_per_step wire vs raw "
                         "either way")
+    p.add_argument("--precision", default="f32",
+                   choices=["f32", "bf16", "bf16-f32master",
+                            "fp16-f32master"],
+                   help="mixed-precision policy for the default/--stream "
+                        "training benches (parallel/precision.py): the "
+                        "model computes at the policy dtype, params/"
+                        "optimizer store per policy, and the JSON line "
+                        "reports precision + param/opt_state bytes per "
+                        "device either way")
     p.add_argument("--grad-bucket-mb", type=float, default=0.0,
                    metavar="MB",
                    help="communication/compute overlap for the default/"
@@ -1423,7 +1461,8 @@ def main() -> None:
                          grad_compression=args.grad_compression,
                          health=args.health,
                          checkpoint_every=args.checkpoint_every,
-                         grad_bucket_mb=args.grad_bucket_mb)
+                         grad_bucket_mb=args.grad_bucket_mb,
+                         precision=args.precision)
         elif mode == "attention":
             bench_attention()
         elif mode == "lm":
@@ -1436,7 +1475,8 @@ def main() -> None:
             bench_throughput(grad_compression=args.grad_compression,
                              health=args.health,
                              checkpoint_every=args.checkpoint_every,
-                             grad_bucket_mb=args.grad_bucket_mb)
+                             grad_bucket_mb=args.grad_bucket_mb,
+                             precision=args.precision)
     except Exception as e:  # noqa: BLE001 — the artifact must stay parsable
         import traceback
         tb = traceback.format_exc()

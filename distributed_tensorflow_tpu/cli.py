@@ -188,6 +188,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-prompt-len", type=int, default=8,
                    help="--serve: prompt tokens taken from the test "
                         "split per request")
+    p.add_argument("--serve-kv-dtype", default=None,
+                   choices=["float32", "f32", "bfloat16", "bf16"],
+                   help="--serve: KV slot-table storage dtype (default: "
+                        "the model's dtype).  bfloat16 halves the KV "
+                        "memory per slot — double the serving slots per "
+                        "chip at equal HBM; greedy tokens stay oracle-"
+                        "exact on the shipped models and the dtype is "
+                        "surfaced in the serve report section")
     p.add_argument("--model-arg", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="extra model constructor field (repeatable), e.g. "
@@ -233,6 +241,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "none is bitwise identical to the uncompressed "
                         "path.  Data-parallel and GSPMD engines; the "
                         "pipeline schedules reject it")
+    p.add_argument("--precision", default="f32",
+                   choices=["f32", "bf16", "bf16-f32master",
+                            "fp16-f32master"],
+                   help="end-to-end mixed-precision policy "
+                        "(parallel/precision.py): param STORAGE + compute "
+                        "+ grad-reduce dtypes, distinct from --dtype "
+                        "(activations only; a non-f32 policy owns the "
+                        "model dtype).  bf16: pure bfloat16 — params AND "
+                        "optimizer state halve.  bf16-f32master: bf16 "
+                        "storage/compute with a float32 master copy "
+                        "inside the optimizer state (the Micikevicius "
+                        "mixed-precision recipe) — param bytes halve, "
+                        "updates below bf16 resolution still accumulate. "
+                        "fp16-f32master: float16 + master + dynamic loss "
+                        "scaling (overflow steps are skipped and the "
+                        "scale backs off; pair with --health on for the "
+                        "anomaly guard).  f32 (default) compiles the "
+                        "byte-identical pre-policy programs.  Pipeline "
+                        "modes reject non-f32 policies")
     p.add_argument("--grad-bucket-mb", type=float, default=0.0,
                    metavar="MB",
                    help="communication/compute overlap: partition the "
@@ -427,6 +454,7 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         warmup_steps=args.warmup_steps,
         grad_accum=args.grad_accum,
         grad_compression=args.grad_compression,
+        precision=args.precision,
         grad_bucket_mb=args.grad_bucket_mb,
         compile_cache=args.compile_cache,
         weight_decay=args.weight_decay,
@@ -475,6 +503,7 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         serve_slots=args.serve_slots,
         serve_max_new=args.serve_max_new,
         serve_prompt_len=args.serve_prompt_len,
+        serve_kv_dtype=args.serve_kv_dtype,
     )
     summary = run(config)  # run() itself wraps recovery when max_restarts>0
     print(json.dumps(summary))

@@ -206,6 +206,41 @@ class Trainer:
         first_anomaly = None
         n_anomalies = 0
         warned_anomaly = False
+        # mixed-precision policy (engine --precision; parallel/precision.py)
+        # — the fit result names it, and a loss-scaling policy gets its
+        # per-step skip accounting surfaced: every skipped (non-finite-
+        # grad) step becomes a structured `loss_scale` tracer event, and
+        # the nan-guard's fatal-divergence response is WAIVED for that
+        # step — the scaler already handled the overflow (backoff + no
+        # update), which is the whole point of fp16-f32master
+        precision_pol = getattr(self.engine, "precision", None)
+        precision_name = getattr(precision_pol, "name", "f32")
+        ls_active = bool(getattr(precision_pol, "loss_scaling", False))
+        ls_skipped_steps: list[int] = []
+        ls_n_skipped = 0
+        ls_last_scale = None
+        warned_skip = False
+
+        def note_loss_scale(gstep: int, floats: dict) -> None:
+            """Per-step loss-scale bookkeeping over materialized floats:
+            record the running scale and turn each skipped step into a
+            structured trace event (the observable half of the grow/
+            backoff loop)."""
+            nonlocal ls_last_scale, warned_skip, ls_n_skipped
+            scale = floats.get("loss_scale")
+            if scale is not None:
+                ls_last_scale = scale
+            if not floats.get("ls_skipped"):
+                return
+            ls_n_skipped += 1
+            if len(ls_skipped_steps) < 64:  # bounded like anomaly_steps
+                ls_skipped_steps.append(gstep)
+            tracer.event("loss_scale", step=gstep, action="backoff_skip",
+                         scale=scale)
+            if not warned_skip:
+                warned_skip = True
+                log_fn(f"step {gstep}  LOSS-SCALE SKIP (non-finite grads; "
+                       f"scale backed off to {scale}) — continuing")
 
         def note_health(gstep: int, floats: dict) -> None:
             """Per-step anomaly policy over materialized health floats:
@@ -229,6 +264,14 @@ class Trainer:
             for a in anomalies:
                 tracer.event("anomaly", step=gstep, policy=on_anomaly, **a)
             a = anomalies[0]
+            if floats.get("ls_skipped"):
+                # the loss scaler already answered this step's non-finite
+                # gradients (skip + backoff — note_loss_scale recorded the
+                # structured event): halting or raising here would defeat
+                # fp16 training, where occasional overflow is EXPECTED and
+                # handled.  The anomaly events above still reach the
+                # trace, so nothing is silent.
+                return
             if on_anomaly == "halt":
                 raise AnomalyDetected(
                     f"health anomaly at step {gstep}: {a['stat']}="
@@ -377,6 +420,14 @@ class Trainer:
             watchdog.rescale(k)
         grad_bytes = eng.grad_collective_bytes(self.state)        # wire
         grad_bytes_raw = eng.grad_collective_bytes_raw(self.state)
+        # per-device state footprint (Engine.param_bytes_per_device /
+        # opt_state_bytes_per_device): the storage numbers the precision
+        # policy moves — bf16 storage halves param bytes, a master policy
+        # grows optimizer bytes by the f32 copy.  Measured off the real
+        # shard sizes, reported in the run report and gated lower-is-
+        # better by `analyze diff`.
+        param_bytes_dev = eng.param_bytes_per_device(self.state)
+        opt_bytes_dev = eng.opt_state_bytes_per_device(self.state)
         grad_codec = getattr(getattr(eng, "grad_codec", None), "name", "none")
         # overlap bucketing (parallel/overlap.py): 0.0 when the codec is
         # unbucketed — the wire figure above is then per-leaf, else
@@ -516,15 +567,19 @@ class Trainer:
                             gstep = start_step + steps
                             examples += bs  # global examples per step
                             dev_metrics = metrics
-                            if health_cfg is not None:
-                                # the anomaly policy needs this step's values:
-                                # materialize now (per-step sync — the honest
-                                # cost of step-exact detection at k=1; the
-                                # chunked drain pays one sync per chunk)
+                            if health_cfg is not None or ls_active:
+                                # the anomaly/loss-scale policy needs this
+                                # step's values: materialize now (per-step
+                                # sync — the honest cost of step-exact
+                                # detection at k=1; the chunked drain pays
+                                # one sync per chunk)
                                 floats = {kk: float(v)
                                           for kk, v in dev_metrics.items()}
                                 record_step(gstep, lambda f=floats: f)
-                                note_health(gstep, floats)
+                                if ls_active:
+                                    note_loss_scale(gstep, floats)
+                                if health_cfg is not None:
+                                    note_health(gstep, floats)
                             else:
                                 record_step(gstep, lambda: {
                                     kk: float(v) for kk, v in dev_metrics.items()})
@@ -588,6 +643,8 @@ class Trainer:
                                 m = {kk: float(v[i]) for kk, v in floats.items()}
                                 metrics = m
                                 record_step(gstep, lambda m=m: m)
+                                if ls_active:
+                                    note_loss_scale(gstep, m)
                                 if health_cfg is not None:
                                     note_health(gstep, m)
 
@@ -708,6 +765,20 @@ class Trainer:
                 "grad_allreduce_bytes_raw": grad_bytes_raw,
                 "grad_compression": grad_codec,
                 "grad_bucket_mb": grad_bucket_mb} if grad_bytes else {}),
+            # mixed-precision policy + the per-device storage footprint it
+            # moves (parallel/precision.py; f32 reports the same keys so
+            # trajectories stay comparable across policies)
+            "precision": precision_name,
+            "param_bytes_per_device": param_bytes_dev,
+            "opt_state_bytes_per_device": opt_bytes_dev,
+            # dynamic loss scaling (fp16-f32master): skip accounting — the
+            # scaler's grow/backoff story, mirrored from the per-step
+            # loss_scale/ls_skipped metrics riding the scan
+            **({"loss_scale": {
+                "final_scale": ls_last_scale,
+                "skipped_steps": ls_n_skipped,
+                "skipped_step_list": ls_skipped_steps,
+            }} if ls_active else {}),
             # checkpoint cost accounting (MLPerf-style: blocked time is
             # charged against throughput, overlapped time is not):
             # checkpoint_wait_s = training-thread seconds inside save/

@@ -50,6 +50,10 @@ class AsyncLocalEngine(Engine):
 
         def init_fn(rng):
             params = self.model.init(rng, x, train=False)["params"]
+            # precision storage cast before tx.init (no-op for f32): the
+            # per-device stack — and a master policy's f32 copy — carry
+            # the policy dtypes from materialization
+            params = self.precision.cast_params(params)
             opt_state = self.tx.init(params)
             state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                                opt_state=opt_state, rng=rng)

@@ -23,6 +23,7 @@ from distributed_tensorflow_tpu.parallel import collectives as coll
 from distributed_tensorflow_tpu.parallel import compression
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 from distributed_tensorflow_tpu.parallel import overlap
+from distributed_tensorflow_tpu.parallel import precision as precisionlib
 
 PyTree = Any
 
@@ -155,12 +156,35 @@ def gspmd_grad_accum(grad_fn, params, x, y, rng, K: int, mesh=None,
     return grads, l_sum / K, jax.tree.map(lambda t: t / K, a_sum)
 
 
-def gspmd_value_and_grad(loss_fn, params, x, y, rng, K: int, mesh=None):
+def gspmd_value_and_grad(loss_fn, params, x, y, rng, K: int, mesh=None,
+                         loss_scale=None):
     """(grads, loss, acc) of a GSPMD step — direct at K == 1, K-microbatch
     accumulated otherwise.  The shared step core of the jit engines
     (tensor_parallel, fsdp); ``loss_fn`` has the make_loss_fn signature.
     ``mesh`` pins microbatch shardings under accumulation (see
-    gspmd_grad_accum)."""
+    gspmd_grad_accum).
+
+    ``loss_scale`` is the GSPMD family's ONE loss-scaling hook
+    (parallel/precision.py fp16-f32master): when given (a traced f32
+    scalar read out of the step's opt_state), the DIFFERENTIATED value is
+    ``loss × scale`` — fp16 backward intermediates stay in range — while
+    the returned metric loss stays unscaled (it rides the aux);
+    gradients come back SCALED and the master-weights wrapper unscales
+    them.  ``None`` (every non-fp16 policy) compiles the exact unscaled
+    program."""
+    if loss_scale is not None:
+        def scaled_fn(p, xc, yc, rng_c):
+            loss, acc = loss_fn(p, xc, yc, rng_c)
+            return loss * loss_scale, (loss, acc)
+
+        grad_fn = jax.value_and_grad(scaled_fn, has_aux=True)
+        if K == 1:
+            (_, (loss, acc)), grads = grad_fn(params, x, y, rng)
+            return grads, loss, acc
+        grads, _scaled_sum, aux = gspmd_grad_accum(
+            grad_fn, params, x, y, rng, K, mesh=mesh)
+        loss, acc = aux
+        return grads, loss, acc
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     if K == 1:
         (loss, acc), grads = grad_fn(params, x, y, rng)
@@ -172,6 +196,12 @@ class Engine:
     """Base: owns model, optimizer, mesh; subclasses build the step program."""
 
     axis = meshlib.DATA_AXIS
+    # engines whose step threads the traced loss scale out of opt_state
+    # into their loss (the fp16-f32master prerequisite) set this True; the
+    # base constructor rejects a scaling policy on any engine that does
+    # not — silently training UNscaled loss while the wrapper divides by
+    # the scale would shrink the effective LR by the scale factor
+    supports_loss_scaling = False
 
     def __init__(
         self,
@@ -181,11 +211,29 @@ class Engine:
         learning_rate: float = 1e-3,
         grad_compression: str | compression.GradCodec = "none",
         grad_bucket_mb: float = 0.0,
+        precision: str | precisionlib.PrecisionPolicy = "f32",
     ):
         self.model = model
         self.tx = optimizer if optimizer is not None else optax.adam(learning_rate)
         self.mesh = mesh if mesh is not None else meshlib.create_mesh()
         self.n_devices = self.mesh.shape[self.axis]
+        # mixed-precision policy (--precision; parallel/precision.py):
+        # 'f32' (default) is a strict no-op — no cast, no wrap, the
+        # compiled programs are byte-identical to the pre-policy ones.
+        # Master policies wrap the optimizer HERE, before enable_health
+        # chains its captures around the result, so health sees the raw
+        # grads in and the final emitted updates out.
+        self.precision = precisionlib.make_policy(precision)
+        if self.precision.loss_scaling and not self.supports_loss_scaling:
+            raise ValueError(
+                f"precision '{self.precision.name}' needs dynamic loss "
+                f"scaling, which {type(self).__name__} does not thread "
+                f"into its loss — use a bf16 policy (bf16/bf16-f32master: "
+                f"bfloat16 shares f32's exponent range, no scaling "
+                f"needed), or train with a loss-scaling engine "
+                f"(sync/allreduce/fsdp/tensor_parallel)")
+        if self.precision.active:
+            self.tx = self.precision.wrap_optimizer(self.tx)
         # cross-device gradient/parameter exchange codec (--grad-compression;
         # parallel/compression.py): 'none' compiles to the pre-codec program.
         # --grad-bucket-mb > 0 wraps it in the bucketed overlap codec
@@ -205,11 +253,16 @@ class Engine:
         self.health = None
         self._health_step_fn = None
         self._health_ema_val = None  # device (ema, count) loss-EMA carry
+        self._precision_step_fn = None  # jitted scale-stats step (fp16)
 
     # ---------------------------------------------------------------- init
     def init_state(self, rng: jax.Array, sample_x: np.ndarray) -> TrainState:
-        """Initialize replicated state (subclasses may re-layout)."""
+        """Initialize replicated state (subclasses may re-layout).  The
+        precision policy's storage cast happens HERE, before ``tx.init``:
+        the optimizer (and a master policy's f32 copy) is built over the
+        params the steps will actually train."""
         params = self.model.init(rng, jnp.asarray(sample_x[:1]), train=False)["params"]
+        params = self.precision.cast_params(params)
         opt_state = self.tx.init(params)
         state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                            opt_state=opt_state, rng=rng)
@@ -302,6 +355,17 @@ class Engine:
         def stepped(state, ema, x, y):
             new_state, metrics = step(state, x, y)
             stats = hl.from_opt_state(new_state.opt_state)
+            if "loss_scale" in metrics:
+                # fp16 loss scaling: the grad capture sits BEFORE the
+                # master-weights unscale, so its norm carries the scale —
+                # divide it back out so grad_norm stays comparable across
+                # precision policies (nan/inf divide through unchanged,
+                # the anomaly signal survives).  The ENTERING state's
+                # scale is the one the gradients were multiplied by;
+                # metrics["loss_scale"] is post-update and differs on
+                # every grow/backoff step
+                entering = precisionlib.loss_scale_from(state.opt_state)
+                stats["grad_norm"] = stats["grad_norm"] / entering
             if "loss" in metrics:
                 spike, ema = hl.ema_spike(metrics["loss"], ema, cfg)
                 stats["loss_spike"] = spike
@@ -309,19 +373,49 @@ class Engine:
 
         return stepped
 
-    # ---------------------------------------------------------------- step
-    def step(self, state: TrainState, x, y):
+    # ----------------------------------------------------------- precision
+    def _precision_wrap(self, step):
+        """``(state, x, y) -> (state, metrics ∪ {loss_scale, ls_skipped})``
+        — read the dynamic-loss-scale bookkeeping back out of the NEW
+        opt_state inside the jit, so skip accounting stacks through the
+        scan exactly like loss/accuracy (k-invariant).  Installed only
+        when the policy scales; every other policy compiles the engine's
+        untouched step."""
+
+        def stepped(state, x, y):
+            new_state, metrics = step(state, x, y)
+            stats = precisionlib.scale_stats_from(new_state.opt_state)
+            return new_state, {**metrics, **stats}
+
+        return stepped
+
+    def _base_step(self):
+        """The engine's step with the precision metrics wrap applied when
+        the policy scales — the single composition point ``step`` and
+        ``build_many_step`` share (the health wrap then goes OUTSIDE, so
+        its anomaly policy sees the scaling stats too)."""
         if self._step_fn is None:
             self._step_fn = self._build_step()
+        if self.precision.loss_scaling:
+            return self._precision_wrap(self._step_fn)
+        return self._step_fn
+
+    # ---------------------------------------------------------------- step
+    def step(self, state: TrainState, x, y):
+        base = self._base_step()
         if self.health is None:
-            return self._step_fn(state, x, y)
+            if not self.precision.loss_scaling:
+                return base(state, x, y)
+            if self._precision_step_fn is None:
+                self._precision_step_fn = jax.jit(base, donate_argnums=0)
+            return self._precision_step_fn(state, x, y)
         if self._health_step_fn is None:
             self._check_health_state(state)
             # the outer jit inlines the engine's jitted step; the state is
             # donated as before (the two-scalar EMA carry is not worth
             # donation bookkeeping)
             self._health_step_fn = jax.jit(
-                self._health_wrap(self._step_fn), donate_argnums=0)
+                self._health_wrap(base), donate_argnums=0)
         state, ema, metrics = self._health_step_fn(
             state, self._health_ema(), x, y)
         self._health_ema_val = ema
@@ -360,9 +454,9 @@ class Engine:
         """
         if k < 1:
             raise ValueError(f"steps_per_call must be >= 1, got {k}")
-        if self._step_fn is None:
-            self._step_fn = self._build_step()
-        step = self._step_fn
+        # loss-scaling policies ride the same wrap here as in step():
+        # the per-step loss_scale/ls_skipped stats stack through the scan
+        step = self._base_step()
 
         if self.health is None:
             def many(state, xs_k, ys_k):
@@ -457,6 +551,38 @@ class Engine:
             return self.grad_codec.wire_bytes(jax.tree.leaves(params))
         except Exception:  # exotic leaf without shape/dtype
             return 0
+
+    def _bytes_per_device(self, tree) -> int:
+        """Bytes of ``tree`` resident on ONE local device — real shard
+        bytes for sharded leaves (FSDP/TP state counts its 1/n), full
+        bytes for replicated/host leaves.  The first *addressable* device
+        keeps the count real on every host of a multi-process mesh."""
+        if tree is None:
+            return 0
+        dev = jax.local_devices()[0]
+        total = 0
+        for leaf in jax.tree.leaves(tree):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards is None:
+                total += int(getattr(leaf, "nbytes", 0) or 0)
+                continue
+            for sh in shards:
+                if sh.device == dev:
+                    total += sh.data.nbytes
+        return total
+
+    def param_bytes_per_device(self, state: TrainState) -> int:
+        """Per-device parameter bytes — THE storage number the precision
+        policy halves (bf16 storage ≈ f32/2): reported in the fit result,
+        run report and bench lines, gated lower-is-better by
+        ``analyze diff``."""
+        return self._bytes_per_device(getattr(state, "params", None))
+
+    def opt_state_bytes_per_device(self, state: TrainState) -> int:
+        """Per-device optimizer-state bytes.  Master policies GROW this
+        (the f32 master lives here — the documented trade of
+        bf16-f32master); the pure ``bf16`` policy halves it."""
+        return self._bytes_per_device(getattr(state, "opt_state", None))
 
     # ---------------------------------------------------------------- eval
     def eval_params(self, state: TrainState) -> PyTree:
@@ -560,6 +686,13 @@ class Engine:
 
         def boxed_init(rng):
             params = module.init(rng, x, train=False)["params"]
+            # storage cast INSIDE the traced init (no-op for f32): the
+            # abstract eval below then derives shardings for the FINAL
+            # dtypes — low-precision params materialize already sharded,
+            # and a master policy's f32 copy (created by tx.init via
+            # jax.tree.map, so nn.Partitioned boxes survive) inherits the
+            # same partition annotations as the params it mirrors
+            params = self.precision.cast_params(params)
             opt_state = self.tx.init(params)
             return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                               opt_state=opt_state, rng=rng)

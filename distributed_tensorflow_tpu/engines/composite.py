@@ -63,7 +63,7 @@ class CompositeEngine(Engine):
                  overflow_warn_threshold: float = 0.25,
                  overflow_window: int = 50, grad_accum: int = 1,
                  grad_compression: str = "none",
-                 grad_bucket_mb: float = 0.0):
+                 grad_bucket_mb: float = 0.0, precision: str = "f32"):
         from distributed_tensorflow_tpu.engines.expert_parallel import (
             _OverflowMonitor)
 
@@ -100,9 +100,13 @@ class CompositeEngine(Engine):
         self.router_z_weight = router_z_weight
         self.overflow_monitor = _OverflowMonitor(overflow_warn_threshold,
                                                  overflow_window)
+        # bf16 precision policies apply (storage cast + master weights ride
+        # the base init/optimizer hooks); fp16-f32master is rejected by the
+        # base — this engine's MoE-aux loss does not thread the loss scale
         super().__init__(model, optimizer, mesh, learning_rate,
                          grad_compression=grad_compression,
-                         grad_bucket_mb=grad_bucket_mb)
+                         grad_bucket_mb=grad_bucket_mb,
+                         precision=precision)
         self.seq_n = mesh.shape.get(meshlib.SEQ_AXIS, 1)
         self.tp_n = mesh.shape.get(meshlib.MODEL_AXIS, 1)
         impl = getattr(model, "attention_impl", "dense")

@@ -130,7 +130,7 @@ class ExpertParallelEngine(Engine):
                  overflow_warn_threshold: float = 0.25,
                  overflow_window: int = 50, grad_accum: int = 1,
                  grad_compression: str = "none",
-                 grad_bucket_mb: float = 0.0):
+                 grad_bucket_mb: float = 0.0, precision: str = "f32"):
         # (data, expert) base mesh; an optional 'model' axis composes ep×tp
         # — each expert's FFN Megatron-split over it (models/moe.py
         # partition_model), still one GSPMD jit
@@ -147,9 +147,12 @@ class ExpertParallelEngine(Engine):
         self.grad_accum = grad_accum
         self.overflow_monitor = _OverflowMonitor(overflow_warn_threshold,
                                                  overflow_window)
+        # bf16 policies ride the base hooks; fp16-f32master is rejected by
+        # the base (the router-aux loss does not thread the loss scale)
         super().__init__(model, optimizer, mesh, learning_rate,
                          grad_compression=grad_compression,
-                         grad_bucket_mb=grad_bucket_mb)
+                         grad_bucket_mb=grad_bucket_mb,
+                         precision=precision)
         # tokens shard over the WHOLE mesh (see shard_batch), so batch
         # divisibility is against every device, not just the data axis
         self.n_devices = (mesh.shape[meshlib.DATA_AXIS]

@@ -45,7 +45,7 @@ class SeqParallelEngine(Engine):
 
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
                  grad_accum: int = 1, grad_compression: str = "none",
-                 grad_bucket_mb: float = 0.0):
+                 grad_bucket_mb: float = 0.0, precision: str = "f32"):
         if mesh is None:
             raise ValueError("SeqParallelEngine requires an explicit "
                              "('data','seq') mesh")
@@ -61,9 +61,12 @@ class SeqParallelEngine(Engine):
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self.grad_accum = grad_accum
+        # bf16 policies ride the base hooks; fp16-f32master is rejected by
+        # the base (no loss-scale thread through the seq-sharded loss)
         super().__init__(model, optimizer, mesh, learning_rate,
                          grad_compression=grad_compression,
-                         grad_bucket_mb=grad_bucket_mb)
+                         grad_bucket_mb=grad_bucket_mb,
+                         precision=precision)
         self.seq_n = mesh.shape[self.seq_axis]
         # causal LMs (models/gpt.py) have (B, L) per-token labels that shard
         # over (data, seq) WITH the inputs, and per-device logits that VARY

@@ -24,6 +24,7 @@ from distributed_tensorflow_tpu.engines.base import Engine, TrainState, make_los
 from distributed_tensorflow_tpu.parallel import collectives as coll
 from distributed_tensorflow_tpu.parallel import compression
 from distributed_tensorflow_tpu.parallel import overlap
+from distributed_tensorflow_tpu.parallel import precision as precisionlib
 
 
 class SyncEngine(Engine):
@@ -39,13 +40,30 @@ class SyncEngine(Engine):
     ``grad_compression`` routes the gradient allreduce through a codec
     (parallel/compression.py): 'none' keeps the exact pre-codec program
     (``_build_step_exact``); bf16/int8 build a separate step whose ONE
-    explicit collective is the codec's (``_build_step_compressed``)."""
+    explicit collective is the codec's (``_build_step_compressed``).
+
+    ``precision`` (parallel/precision.py): low-precision param storage
+    makes the gradient psum itself move the narrow dtype (grads share
+    the params' dtype — the wire win with NO codec); fp16-f32master's
+    loss scale is threaded out of opt_state into the loss here
+    (``supports_loss_scaling``), and the master-weights wrapper installed
+    by the base unscales the gradients after the reduce."""
+
+    supports_loss_scaling = True
 
     def __init__(self, *args, grad_accum: int = 1, **kw):
         if grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         super().__init__(*args, **kw)
         self.grad_accum = grad_accum
+
+    def _loss_scale(self, opt_state):
+        """The traced loss scale of the entering state, or None when the
+        policy does not scale (python gate: the scale-free programs stay
+        byte-identical)."""
+        if not self.precision.loss_scaling:
+            return None
+        return precisionlib.loss_scale_from(opt_state)
 
     def _build_step(self):
         # bucketing alone (codec 'none' + --grad-bucket-mb) also takes the
@@ -65,9 +83,16 @@ class SyncEngine(Engine):
         def device_step(state: TrainState, x, y):
             rng = self._per_device_rng(state.rng, state.step)
             n = jax.lax.axis_size(axis)
+            # dynamic loss scale (fp16-f32master): multiply the
+            # differentiated loss by the scale the entering opt_state
+            # carries; the master-weights wrapper divides the gradients
+            # back out.  None (every other policy) adds nothing.
+            ls = self._loss_scale(state.opt_state)
 
             def scaled_loss(params, xc, yc, rng_c):
                 loss, acc = loss_fn(params, xc, yc, rng_c)
+                if ls is not None:
+                    return loss * ls / (n * K), (loss, acc)
                 # scale so the cross-device AND cross-microbatch SUM of
                 # losses is the global batch mean: under shard_map's
                 # varying-axes typing, grad-of-replicated-params IS psum'd
@@ -190,12 +215,20 @@ class SyncEngine(Engine):
             # exchange (that independence is what makes the rounding noise
             # average out across the ring)
             codec_key = compression.codec_rng(rng)
+            ls = self._loss_scale(state.opt_state)
 
             def scaled_loss(params, xc, yc, rng_c):
                 loss, acc = loss_fn(params, xc, yc, rng_c)
                 # same 1/(n·K) scale as the exact path: the codec's SUM of
-                # per-device (per-microbatch) grads is the global mean
-                return loss / (n * K), (loss, acc)
+                # per-device (per-microbatch) grads is the global mean —
+                # times the dynamic loss scale when the policy scales
+                # (unscaled by the master-weights wrapper after the
+                # reduce; the python gate keeps scale-free programs
+                # byte-identical)
+                scaled = loss / (n * K)
+                if ls is not None:
+                    scaled = scaled * ls
+                return scaled, (loss, acc)
 
             grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
 

@@ -27,6 +27,7 @@ from distributed_tensorflow_tpu.engines.base import (
     Engine, TrainState, gspmd_value_and_grad, make_loss_fn)
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 from distributed_tensorflow_tpu.parallel import compression
+from distributed_tensorflow_tpu.parallel import precision as precisionlib
 
 
 class TPMLP(nn.Module):
@@ -75,11 +76,18 @@ class TensorParallelEngine(Engine):
     ``grad_accum`` K > 1 accumulates K microbatch gradients per optimizer
     step under the same GSPMD jit (base.gspmd_grad_accum) — identical math
     to K=1 on the same global batch, ~K× less activation memory.
+
+    ``precision`` (parallel/precision.py): Megatron-annotated params (and
+    a master policy's f32 copy — annotations survive the tree-mapped
+    cast) store/compute low-precision; fp16-f32master's loss scale rides
+    the shared ``gspmd_value_and_grad`` hook.
     """
+
+    supports_loss_scaling = True
 
     def __init__(self, model, optimizer=None, mesh=None, learning_rate=1e-3,
                  grad_accum: int = 1, grad_compression: str = "none",
-                 grad_bucket_mb: float = 0.0):
+                 grad_bucket_mb: float = 0.0, precision: str = "f32"):
         if mesh is None or set(mesh.axis_names) != {meshlib.DATA_AXIS,
                                                     meshlib.MODEL_AXIS}:
             raise ValueError("TensorParallelEngine requires a ('data','model') mesh")
@@ -87,7 +95,8 @@ class TensorParallelEngine(Engine):
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         super().__init__(model, optimizer, mesh, learning_rate,
                          grad_compression=grad_compression,
-                         grad_bucket_mb=grad_bucket_mb)
+                         grad_bucket_mb=grad_bucket_mb,
+                         precision=precision)
         self.grad_accum = grad_accum
 
     def init_state(self, rng, sample_x) -> TrainState:
@@ -98,10 +107,15 @@ class TensorParallelEngine(Engine):
         tx, K = self.tx, self.grad_accum
         codec = self.grad_codec
 
+        scaling = self.precision.loss_scaling
+
         def train_step(state: TrainState, x, y):
             rng = jax.random.fold_in(state.rng, state.step)
+            ls = (precisionlib.loss_scale_from(state.opt_state)
+                  if scaling else None)
             grads, loss, acc = gspmd_value_and_grad(
-                loss_fn, state.params, x, y, rng, K, mesh=self.mesh)
+                loss_fn, state.params, x, y, rng, K, mesh=self.mesh,
+                loss_scale=ls)
             if codec.name != "none":
                 # GSPMD inserts the data-axis gradient all-reduce itself,
                 # so the codec applies as a quantize→dequantize roundtrip

@@ -187,8 +187,15 @@ class CausalSelfAttention(nn.Module):
                 else:
                     idx = pos[:, 0]
                     rows = jnp.arange(b)
-                    ck.value = ck.value.at[rows, idx].set(k[:, 0])
-                    cv.value = cv.value.at[rows, idx].set(v[:, 0])
+                    # cast to the table's dtype: the serving engine may
+                    # store the KV table narrower than the compute dtype
+                    # (SlotKVCache kv_dtype — bf16 halves KV memory); a
+                    # same-dtype astype is the identity, so the default
+                    # program is untouched
+                    ck.value = ck.value.at[rows, idx].set(
+                        k[:, 0].astype(ck.value.dtype))
+                    cv.value = cv.value.at[rows, idx].set(
+                        v[:, 0].astype(cv.value.dtype))
                     valid = (jnp.arange(self.max_len)[None, :]
                              <= idx[:, None]).astype(self.dtype)
                     out = dense_attention(

@@ -257,6 +257,17 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     ("step_time_mean_s", "lower"), ("compile_s", "lower"),
     ("elapsed_s", "lower"), ("telemetry_overhead_frac", "lower"),
     ("grad_allreduce_bytes", "lower"),
+    # per-device state footprint (--precision; run report AND bench line):
+    # the storage numbers mixed precision exists to shrink — param bytes
+    # halve under bf16 storage; optimizer bytes are gated too so a master
+    # policy's f32 copy (a deliberate, bounded cost) cannot silently grow
+    # past what the policy change justified
+    ("param_bytes_per_device", "lower"),
+    ("opt_state_bytes_per_device", "lower"),
+    # fp16 dynamic-loss-scale skips (flattened from the loss_scale
+    # section below): a step that skipped did no training — more skips at
+    # equal work is a regression
+    ("loss_scale_skipped_steps", "lower"),
     # exposed gradient-collective seconds (run report AND bench line —
     # the communication/compute-overlap gate, BASELINE.md: exposed time
     # is the number that must go down; hidden_s is deliberately NOT
@@ -323,6 +334,11 @@ def load_report(path: str | Path) -> dict[str, Any]:
     health = flat.get("health")
     if isinstance(health, dict) and "anomalies" in health:
         flat.setdefault("health_anomalies", health["anomalies"])
+    # the fp16 loss-scale section's skip count surfaces flat so scaling
+    # regressions diff with the same machinery as everything else
+    ls = flat.get("loss_scale")
+    if isinstance(ls, dict) and "skipped_steps" in ls:
+        flat.setdefault("loss_scale_skipped_steps", ls["skipped_steps"])
     # a run report's nested `serve` section surfaces its serve_* metrics
     # at the top level so serving runs diff with the same machinery as
     # training runs (bench --serve lines already emit them flat)

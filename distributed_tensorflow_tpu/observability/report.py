@@ -109,6 +109,17 @@ def build_run_report(fit_result: dict[str, Any], *,
         "grad_allreduce_bytes_raw": fit_result.get(
             "grad_allreduce_bytes_raw"),
         "grad_compression": fit_result.get("grad_compression"),
+        # mixed-precision policy (--precision; parallel/precision.py) +
+        # the per-device state footprint it moves: param bytes halve
+        # under bf16 storage, optimizer bytes grow by a master policy's
+        # f32 copy — both gated lower-is-better by `analyze diff`.
+        # loss_scale is the fp16 skip-accounting section (None: policy
+        # without dynamic scaling).
+        "precision": fit_result.get("precision"),
+        "param_bytes_per_device": fit_result.get("param_bytes_per_device"),
+        "opt_state_bytes_per_device": fit_result.get(
+            "opt_state_bytes_per_device"),
+        "loss_scale": fit_result.get("loss_scale"),
         # communication/compute overlap (--grad-bucket-mb;
         # parallel/overlap.py): the bucket size in effect, and the
         # exposed-vs-hidden collective split the one-time probe measured

@@ -1,0 +1,392 @@
+"""End-to-end mixed-precision training policies (Micikevicius et al.,
+arXiv:1710.03740 — PAPERS.md).
+
+``--dtype bfloat16`` (the model knob that predates this module) only casts
+*activations*: params, grads and optimizer state stay float32, so memory,
+HBM bandwidth and the gradient collectives never see the low-precision
+win.  This module makes storage precision a POLICY wired once at the
+engine base (the same pattern as ``grad_codec`` and ``enable_health``):
+
+  ``f32``             everything float32 — the default, and a strict
+                      no-op: no cast, no optimizer wrap, the compiled
+                      step program is byte-identical to the pre-policy
+                      one (acceptance-tested bitwise).
+  ``bf16``            pure low precision: params stored bfloat16, compute
+                      bfloat16, optimizer state bfloat16 (optax moments
+                      inherit the param dtype).  Halves params AND
+                      optimizer bytes; no master copy, so tiny updates
+                      can round away in the bf16 add — the aggressive
+                      mode, guarded by the health layer.
+  ``bf16-f32master``  the paper's recipe: params stored/computed bfloat16
+                      with a float32 MASTER copy kept inside the
+                      optimizer state (``master_weights`` below).  The
+                      optimizer updates the master; the bf16 params are
+                      re-derived as ``cast(master)`` every step, so
+                      updates below bf16 resolution still accumulate.
+                      bf16 shares float32's exponent range, so no loss
+                      scaling is needed.
+  ``fp16-f32master``  float16 storage/compute + f32 master + DYNAMIC LOSS
+                      SCALING: fp16's 5-bit exponent underflows small
+                      backward intermediates, so the loss is multiplied
+                      by a running scale before AD (engines thread the
+                      traced scale out of ``opt_state`` into their loss —
+                      ``Engine.supports_loss_scaling`` names the engines
+                      that do), gradients are unscaled inside the
+                      wrapper, and a non-finite gradient SKIPS the step
+                      (master/optimizer untouched, params unchanged) and
+                      backs the scale off; ``growth_interval`` consecutive
+                      finite steps grow it back.  Skip accounting rides
+                      the step metrics (``loss_scale`` / ``ls_skipped``)
+                      through the scan, so the Trainer's anomaly policy
+                      sees every handled overflow as a structured event
+                      instead of a silent NaN trajectory.
+
+Master-weights mechanics (why no engine step changes are needed): every
+engine applies updates via ``optax.apply_updates(params, updates)``, which
+computes ``p + u`` under numpy promotion and casts back to ``p.dtype``.
+The wrapper emits ``u = cast_lp(master') − p`` in FLOAT32: low-precision
+values are exactly representable in f32, so ``p + u == cast_lp(master')``
+exactly and the engine's own apply lands the params on the downcast master
+— the invariant ``params == cast(master)`` holds every step, making a
+skipped step's emitted update exactly zero.
+
+Wire composition: with bf16 param storage the gradients ARE bf16, so the
+data-axis reduce moves 2 bytes/param with no codec — and the PR 3 codecs
+compose without double-casting (``Bf16Codec`` passes ≤2-byte floats
+through untouched; ``Int8Codec`` quantizes them like any float).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+PyTree = Any
+
+POLICIES = ("f32", "bf16", "bf16-f32master", "fp16-f32master")
+
+# per-step metric keys the scaling wrap adds to the trajectory
+SCALE_KEYS = ("loss_scale", "ls_skipped")
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """One resolved ``--precision`` value: the four dtypes of mixed
+    precision (storage, compute, grad-reduce, master) plus the dynamic
+    loss-scale shape.  ``active`` False (the ``f32`` policy) means every
+    hook is a python-gated no-op — the compiled programs are the
+    pre-policy ones, bitwise."""
+
+    name: str = "f32"
+    param_dtype: Any = jnp.float32    # TrainState.params storage dtype
+    compute_dtype: Any = jnp.float32  # model activation/matmul dtype
+    master_dtype: Any = None          # f32 master copy in opt_state (None:
+                                      # no master — optimizer runs on the
+                                      # stored params directly)
+    loss_scaling: bool = False        # dynamic loss scale (fp16 paths)
+    init_scale: float = 2.0 ** 15
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 200        # consecutive finite steps per growth
+
+    @property
+    def active(self) -> bool:
+        return self.name != "f32"
+
+    @property
+    def grad_reduce_dtype(self):
+        """Dtype the gradient collective moves: grads share the stored
+        params' dtype, so storage dtype IS the reduce dtype."""
+        return self.param_dtype
+
+    # ----------------------------------------------------------- casting
+    def cast_params(self, params: PyTree) -> PyTree:
+        """Float param leaves → the policy's storage dtype (identity for
+        ``f32`` — python-gated, never traced into the no-op program)."""
+        if not self.active:
+            return params
+        dt = self.param_dtype
+        return jax.tree.map(
+            lambda p: p.astype(dt)
+            if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else p,
+            params)
+
+    # --------------------------------------------------------- optimizer
+    def wrap_optimizer(self, tx: optax.GradientTransformation
+                       ) -> optax.GradientTransformation:
+        """The whole install: master weights (+ loss scaling) around the
+        engine's optimizer when the policy keeps a master, the optimizer
+        untouched otherwise.  Called once from ``Engine.__init__`` —
+        BEFORE ``enable_health`` wraps, so the health captures see the
+        raw incoming grads and the final emitted updates."""
+        if self.master_dtype is None:
+            return tx
+        return master_weights(tx, self)
+
+
+def make_policy(precision: str | PrecisionPolicy | None) -> PrecisionPolicy:
+    """Resolve a ``--precision`` value (or a ready policy) — typos fail
+    here with the full menu, not deep inside an engine constructor."""
+    if precision is None:
+        return PrecisionPolicy()
+    if isinstance(precision, PrecisionPolicy):
+        return precision
+    if precision in ("f32", "float32"):
+        return PrecisionPolicy()
+    if precision == "bf16":
+        return PrecisionPolicy(name="bf16", param_dtype=jnp.bfloat16,
+                               compute_dtype=jnp.bfloat16)
+    if precision == "bf16-f32master":
+        return PrecisionPolicy(name="bf16-f32master",
+                               param_dtype=jnp.bfloat16,
+                               compute_dtype=jnp.bfloat16,
+                               master_dtype=jnp.float32)
+    if precision == "fp16-f32master":
+        return PrecisionPolicy(name="fp16-f32master",
+                               param_dtype=jnp.float16,
+                               compute_dtype=jnp.float16,
+                               master_dtype=jnp.float32,
+                               loss_scaling=True)
+    raise ValueError(f"unknown precision '{precision}'; "
+                     f"known: {', '.join(POLICIES)}")
+
+
+# ----------------------------------------------------------- master weights
+
+class MasterWeightsState(NamedTuple):
+    """Optimizer-state node of the master-weights wrapper.  ``master`` is
+    the f32 copy the inner optimizer actually updates; ``inner`` its
+    state (init'd ON the master, so adam moments etc. stay f32).  The
+    scale fields are constants when the policy has no loss scaling."""
+
+    master: Any            # f32 master params (sharded like the params)
+    inner: Any             # inner optimizer state over the master
+    loss_scale: jax.Array  # f32 scalar — the scale the NEXT step's loss
+    #                        must be multiplied by (engines read it via
+    #                        loss_scale_from)
+    good_steps: jax.Array  # i32 consecutive finite steps since last change
+    skipped: jax.Array     # i32 total non-finite (skipped) steps
+    last_skipped: jax.Array  # bool: the most recent update was skipped
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def master_weights(tx: optax.GradientTransformation,
+                   policy: PrecisionPolicy) -> optax.GradientTransformation:
+    """f32-master optimizer wrapper (the Micikevicius recipe as a pure
+    ``optax`` transformation — no engine step changes):
+
+    * ``init(params_lp)``: master = upcast(params), inner = tx.init(master)
+      — moments and schedules run full precision over the master;
+    * ``update(grads, state, params_lp)``: widen grads to the master
+      dtype (unscale by ``loss_scale`` when the policy scales), update
+      the MASTER, and emit ``cast_lp(master') − params`` in f32 so the
+      engine's ``optax.apply_updates`` lands params exactly on the
+      downcast master (module docstring for why that is exact);
+    * with ``loss_scaling``: a non-finite gradient skips the whole update
+      (master/inner unchanged → emitted update exactly 0), multiplies the
+      scale by ``backoff_factor`` and counts the skip;
+      ``growth_interval`` consecutive finite steps multiply it by
+      ``growth_factor``.  All inside the jit — skip accounting stacks
+      through the scan like any metric.
+    """
+    mdt = policy.master_dtype
+    scaling = policy.loss_scaling
+
+    def init(params):
+        master = jax.tree.map(
+            lambda p: p.astype(mdt) if _is_float(p) else p, params)
+        return MasterWeightsState(
+            master=master,
+            inner=tx.init(master),
+            loss_scale=jnp.asarray(policy.init_scale if scaling else 1.0,
+                                   jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            skipped=jnp.zeros((), jnp.int32),
+            last_skipped=jnp.zeros((), jnp.bool_))
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError(
+                "master_weights needs tx.update(grads, opt_state, params) — "
+                "every engine in this repo passes params")
+        g = jax.tree.map(
+            lambda u: u.astype(mdt) if _is_float(u) else u, updates)
+        if scaling:
+            inv = (1.0 / state.loss_scale).astype(jnp.float32)
+            g = jax.tree.map(
+                lambda u: u * inv.astype(u.dtype) if _is_float(u) else u, g)
+            finite = jnp.array(True)
+            for leaf in jax.tree.leaves(g):
+                if _is_float(leaf):
+                    finite = finite & jnp.all(jnp.isfinite(leaf))
+        u, inner_new = tx.update(g, state.inner, state.master)
+        master_new = optax.apply_updates(state.master, u)
+        if scaling:
+            # non-finite grads: discard the candidate update entirely —
+            # master, inner state and (via the zero emitted delta below)
+            # the params stay at their pre-step values
+            keep = lambda new, old: jax.tree.map(  # noqa: E731
+                lambda a, b: jnp.where(finite, a, b), new, old)
+            master_new = keep(master_new, state.master)
+            inner_new = keep(inner_new, state.inner)
+            grown = jnp.where(
+                state.good_steps + 1 >= policy.growth_interval,
+                state.loss_scale * policy.growth_factor, state.loss_scale)
+            scale_new = jnp.where(finite, grown,
+                                  state.loss_scale * policy.backoff_factor)
+            # keep the scale in a sane band: growth is capped where fp16's
+            # own max would make every step overflow; backoff floors at 1
+            scale_new = jnp.clip(scale_new, 1.0, 2.0 ** 24)
+            good_new = jnp.where(
+                finite & (scale_new == state.loss_scale),
+                state.good_steps + 1, jnp.zeros((), jnp.int32))
+            skipped_new = state.skipped + (~finite).astype(jnp.int32)
+            last_skipped = ~finite
+        else:
+            scale_new = state.loss_scale
+            good_new = state.good_steps
+            skipped_new = state.skipped
+            last_skipped = state.last_skipped
+        # emitted in f32: p + (cast(m') − p) == cast(m') exactly (low-
+        # precision values are f32-representable), so apply_updates lands
+        # the params on the downcast master — and a skipped step's delta
+        # is exactly zero (params == cast(master) invariant)
+        emitted = jax.tree.map(
+            lambda m, p: (m.astype(p.dtype).astype(jnp.float32)
+                          - p.astype(jnp.float32)) if _is_float(p)
+            else jnp.zeros_like(p),
+            master_new, params)
+        return emitted, MasterWeightsState(
+            master=master_new, inner=inner_new, loss_scale=scale_new,
+            good_steps=good_new, skipped=skipped_new,
+            last_skipped=jnp.asarray(last_skipped, jnp.bool_))
+
+    return optax.GradientTransformation(init, update)
+
+
+# -------------------------------------------------------- opt_state readers
+
+def _find_master(opt_state: Any) -> list[MasterWeightsState]:
+    found: list[MasterWeightsState] = []
+
+    def visit(x):
+        if isinstance(x, MasterWeightsState):
+            found.append(x)
+        return x
+
+    jax.tree.map(visit, opt_state,
+                 is_leaf=lambda x: isinstance(x, MasterWeightsState))
+    return found
+
+
+def loss_scale_from(opt_state: Any) -> jax.Array:
+    """The traced loss scale the CURRENT step's loss must be multiplied
+    by, read out of the (possibly nested) optimizer state.  Engines with
+    ``supports_loss_scaling`` call this inside their step when the
+    policy scales — python-gated, so scale-free programs never trace it."""
+    masters = _find_master(opt_state)
+    if not masters:
+        raise ValueError(
+            "no MasterWeightsState in opt_state — the loss-scaling policy "
+            "must wrap the optimizer before init_state()")
+    # per-device-stacked states (async/gossip) carry a stacked scalar; all
+    # rows are identical, reduce with max for a plain scalar
+    return jnp.max(masters[0].loss_scale).astype(jnp.float32)
+
+
+def scale_stats_from(opt_state: Any) -> dict[str, jax.Array]:
+    """Per-step scaling metrics merged into the trajectory by the base
+    engine's precision wrap: the scale in effect after the step, and
+    whether the step was skipped (non-finite grads)."""
+    m = _find_master(opt_state)[0]
+    return {
+        "loss_scale": jnp.max(m.loss_scale).astype(jnp.float32),
+        "ls_skipped": jnp.max(m.last_skipped.astype(jnp.int32)),
+    }
+
+
+# ------------------------------------------------- f32-checkpoint adoption
+
+def _is_master(x) -> bool:
+    return isinstance(x, MasterWeightsState)
+
+
+def strip_master(opt_state: Any) -> Any:
+    """The f32-era rendering of a master-policy optimizer state: every
+    ``MasterWeightsState`` node replaced by its ``inner`` — exactly the
+    tree an ``f32``-policy run of the same optimizer/health stack
+    produces (the master wrapper is the only structural delta)."""
+    return jax.tree.map(lambda x: x.inner if _is_master(x) else x,
+                        opt_state, is_leaf=_is_master)
+
+
+def f32_template(state: Any) -> Any:
+    """An f32-policy restore template derived from a master-policy
+    state: params upcast to the master dtype, the master wrapper
+    stripped from the optimizer state.  Used to restore a checkpoint
+    WRITTEN by an f32 run into a mixed-precision run."""
+    params32 = jax.tree.map(
+        lambda p: p.astype(jnp.float32) if _is_float(p) else p,
+        state.params)
+    return state.replace(params=params32,
+                         opt_state=strip_master(state.opt_state))
+
+
+def adopt_f32_state(template: Any, restored32: Any,
+                    policy: PrecisionPolicy) -> Any:
+    """Re-render an f32-policy state under a master policy: the restored
+    f32 params become the MASTER (and their downcast the stored params),
+    the restored inner optimizer state nests back where the template's
+    ``MasterWeightsState`` sits, and the loss-scale fields restart fresh
+    (an f32 checkpoint carries none)."""
+    params32 = restored32.params
+    master = jax.tree.map(
+        lambda p: p.astype(policy.master_dtype) if _is_float(p) else p,
+        params32)
+    params_lp = policy.cast_params(params32)
+
+    def renest(t_node, r_node):
+        if _is_master(t_node):
+            return MasterWeightsState(
+                master=master, inner=r_node,
+                loss_scale=jnp.asarray(
+                    policy.init_scale if policy.loss_scaling else 1.0,
+                    jnp.float32),
+                good_steps=jnp.zeros((), jnp.int32),
+                skipped=jnp.zeros((), jnp.int32),
+                last_skipped=jnp.zeros((), jnp.bool_))
+        return r_node
+
+    opt_state = jax.tree.map(renest, template.opt_state,
+                             restored32.opt_state, is_leaf=_is_master)
+    return restored32.replace(params=params_lp, opt_state=opt_state)
+
+
+def restore_into_policy(manager, template: Any,
+                        policy: PrecisionPolicy) -> Any:
+    """Restore the latest checkpoint into ``template``'s layout, policy-
+    aware: a checkpoint written under the SAME policy restores directly;
+    a checkpoint written by an f32 run (no master in its optimizer tree)
+    restores through the f32 template and is adopted — master := the
+    restored f32 params, stored params := their downcast.  Raises the
+    direct-restore error when neither structure matches."""
+    try:
+        return manager.restore(template)
+    except Exception as direct_err:
+        if policy.master_dtype is None:
+            raise
+        try:
+            restored32 = manager.restore(f32_template(template))
+        except Exception:
+            # neither layout matches: the DIRECT error is the informative
+            # one (same-policy structure/IO mismatch) — the f32-template
+            # failure is just "also not that shape"
+            raise direct_err
+        return adopt_f32_state(template, restored32, policy)

@@ -80,7 +80,7 @@ class SlotKVCache:
 
     def __init__(self, model: GPTLM, params, slots: int, *,
                  mesh=None, greedy: bool = True, temperature: float = 1.0,
-                 prefill_bucket: int = 8, rng=None):
+                 prefill_bucket: int = 8, rng=None, kv_dtype=None):
         if slots < 1:
             raise ValueError(f"slots must be positive, got {slots}")
         self.slots = int(slots)
@@ -103,6 +103,21 @@ class SlotKVCache:
             lambda: self.dm.init(jax.random.key(0), dummy, train=False,
                                  positions=dummy))["cache"]
         cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        if kv_dtype is not None:
+            # --serve-kv-dtype: store the K/V table narrower than the
+            # model computes (bf16 halves KV memory → double the slots per
+            # chip).  The model's slot-scatter writes cast to the table's
+            # dtype (models/gpt.py) and the attention read promotes back,
+            # so the decode program stays the one compiled step.
+            kv_dtype = jnp.dtype(kv_dtype)
+            cache = jax.tree.map(
+                lambda t: t.astype(kv_dtype)
+                if jnp.issubdtype(t.dtype, jnp.floating) else t, cache)
+        # the table's actual storage dtype (first float leaf — the K/V
+        # buffers), surfaced in the serve report section
+        self.kv_dtype = next(
+            (str(leaf.dtype) for leaf in jax.tree.leaves(cache)
+             if jnp.issubdtype(leaf.dtype, jnp.floating)), "float32")
 
         self._vec_sharding = None
         if mesh is not None:

@@ -334,6 +334,9 @@ class ContinuousBatcher:
             "mode": self.mode,
             "requests": len(results),
             "completed": len(results),
+            # KV-table storage dtype (SlotKVCache kv_dtype — the --serve-
+            # kv-dtype memory knob); rides into the serve report section
+            "serve_kv_dtype": getattr(self.kv, "kv_dtype", None),
             "decode_iterations": decode_iterations,
             "prefills": prefills,
             "tokens_generated": tokens,

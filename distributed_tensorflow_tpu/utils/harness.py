@@ -61,6 +61,17 @@ class ExperimentConfig:
                                     # exchange codec: none | bf16 | int8
                                     # (parallel/compression.py; pipeline
                                     # modes reject it)
+    precision: str = "f32"          # end-to-end mixed-precision policy
+                                    # (parallel/precision.py): f32 | bf16 |
+                                    # bf16-f32master | fp16-f32master.
+                                    # Storage + compute + grad-reduce
+                                    # dtypes with an optional f32 master
+                                    # copy inside the optimizer state;
+                                    # 'f32' compiles the byte-identical
+                                    # pre-policy programs.  Distinct from
+                                    # `dtype` (the activation-only knob):
+                                    # a non-f32 policy OWNS the model
+                                    # dtype — see _resolve_precision
     grad_bucket_mb: float = 0.0     # >0: communication/compute overlap —
                                     # partition the grad pytree into
                                     # size-targeted buckets (reverse-
@@ -195,6 +206,10 @@ class ExperimentConfig:
     serve_max_new: int = 16                # tokens generated per request
     serve_prompt_len: int = 8              # prompt tokens taken from the
                                            # test split per request
+    serve_kv_dtype: str | None = None      # --serve KV-table storage dtype
+                                           # ('bfloat16' halves KV memory →
+                                           # double the slots per chip);
+                                           # None: the model's dtype
 
 
 def enable_compile_cache(directory: str | os.PathLike) -> str:
@@ -320,7 +335,51 @@ def _validate_grad_bucket(config: ExperimentConfig) -> None:
             "without -pp")
 
 
+def _resolve_precision(config: ExperimentConfig) -> ExperimentConfig:
+    """Validate ``--precision`` and resolve the model dtype it implies.
+
+    The policy owns end-to-end precision (storage + compute + grad
+    reduce), so with a non-f32 policy the model's compute dtype FOLLOWS
+    the policy: ``--dtype`` left at its float32 default is overridden to
+    the policy's compute dtype; an explicit matching ``--dtype`` is
+    fine; a CONFLICTING one is rejected (silently computing f32 over
+    bf16-stored params would promote every matmul back to f32 and hand
+    the user neither win).  ``--precision f32`` leaves ``--dtype``'s
+    activation-only behavior exactly as before (MIGRATING.md).  Pipeline
+    modes reject non-f32 policies with the same named reason as
+    --grad-compression: stage params live per-'pipe' inside a manual
+    shard_map axis with their own optimizer handling."""
+    from distributed_tensorflow_tpu import models as modellib
+    from distributed_tensorflow_tpu.parallel import precision as precisionlib
+
+    pol = precisionlib.make_policy(config.precision)  # typo → full menu
+    if not pol.active:
+        return config
+    if config.pipeline_parallel > 1:
+        raise ValueError(
+            "--precision is implemented for the data-parallel and GSPMD "
+            "engines (sync/async/allreduce/gossip/fsdp, -tp, -sp, -ep and "
+            "their composites); the pipeline schedules (-pp) are not "
+            "supported — drop the flag or train without -pp")
+    compute = modellib.resolve_dtype(pol.compute_dtype)
+    asked = modellib.resolve_dtype(config.dtype)
+    if asked is not modellib.resolve_dtype("float32") and asked is not compute:
+        raise ValueError(
+            f"--dtype {config.dtype} conflicts with --precision "
+            f"{pol.name} (compute dtype {jnp_name(compute)}): a non-f32 "
+            f"policy owns the model dtype — drop --dtype or make them "
+            f"agree")
+    return dataclasses.replace(config, dtype=str(np.dtype(compute)))
+
+
+def jnp_name(dtype) -> str:
+    import jax.numpy as jnp
+
+    return jnp.dtype(dtype).name
+
+
 def _setup(config: ExperimentConfig) -> _Experiment:
+    config = _resolve_precision(config)
     # the z-loss is applied by the MoE-aware engines: the -ep paths, and
     # the tp×sp composite when the model carries MoE blocks
     # (--model-arg moe_experts=N)
@@ -421,7 +480,8 @@ def _setup(config: ExperimentConfig) -> _Experiment:
         mesh=mesh, learning_rate=config.learning_rate,
         optimizer=_make_optimizer(config, train_ds, global_batch),
         grad_compression=config.grad_compression,
-        grad_bucket_mb=config.grad_bucket_mb)
+        grad_bucket_mb=config.grad_bucket_mb,
+        precision=config.precision)
     if config.engine == "async":
         engine_kw["sync_every"] = config.sync_every
     elif config.engine == "gossip":
@@ -700,7 +760,8 @@ def _setup_seq_parallel(config: ExperimentConfig) -> _Experiment:
                                   _global_batch(config, dp)),
         grad_accum=config.grad_accum,
         grad_compression=config.grad_compression,
-        grad_bucket_mb=config.grad_bucket_mb)
+        grad_bucket_mb=config.grad_bucket_mb,
+        precision=config.precision)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name=f"seq_parallel[{config.attention_impl}]")
@@ -745,7 +806,8 @@ def _setup_tensor_parallel(config: ExperimentConfig) -> _Experiment:
                                   _global_batch(config, dp)),
         grad_accum=config.grad_accum,
         grad_compression=config.grad_compression,
-        grad_bucket_mb=config.grad_bucket_mb)
+        grad_bucket_mb=config.grad_bucket_mb,
+        precision=config.precision)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name="tensor_parallel")
@@ -772,7 +834,8 @@ def _setup_fsdp_tp(config: ExperimentConfig) -> _Experiment:
                                   _global_batch(config, dp)),
         grad_accum=config.grad_accum,
         grad_compression=config.grad_compression,
-        grad_bucket_mb=config.grad_bucket_mb)
+        grad_bucket_mb=config.grad_bucket_mb,
+        precision=config.precision)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name="fsdp_tp[fsdp*tp]")
@@ -940,7 +1003,8 @@ def _setup_composite(config: ExperimentConfig) -> _Experiment:
         router_z_weight=config.router_z_weight,
         grad_accum=config.grad_accum,
         grad_compression=config.grad_compression,
-        grad_bucket_mb=config.grad_bucket_mb)
+        grad_bucket_mb=config.grad_bucket_mb,
+        precision=config.precision)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name=f"composite[dp*tp*sp,{config.attention_impl}]")
@@ -1169,7 +1233,8 @@ def _setup_expert_parallel(config: ExperimentConfig,
         router_z_weight=config.router_z_weight,
         grad_accum=config.grad_accum,
         grad_compression=config.grad_compression,
-        grad_bucket_mb=config.grad_bucket_mb)
+        grad_bucket_mb=config.grad_bucket_mb,
+        precision=config.precision)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine,
                        global_batch=_global_batch(config, n_token_shards),
@@ -1278,7 +1343,8 @@ def _setup_expert_sp(config: ExperimentConfig, tp: int = 1) -> _Experiment:
         router_z_weight=config.router_z_weight,
         grad_accum=config.grad_accum,
         grad_compression=config.grad_compression,
-        grad_bucket_mb=config.grad_bucket_mb)
+        grad_bucket_mb=config.grad_bucket_mb,
+        precision=config.precision)
     return _Experiment(mesh=mesh, n=dp, train_ds=train_ds, test_ds=test_ds,
                        engine=engine, global_batch=_global_batch(config, dp),
                        name=(f"expert_tp_sp[dp*ep*tp*sp,{config.attention_impl}]" if tp > 1
@@ -1372,7 +1438,16 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                 template = ex.engine.init_state(
                     rng, train_ds.x[: max(1, ex.n)])
                 try:
-                    trainer.state = ckpt_mgr.restore(template)
+                    # policy-aware restore: a checkpoint written under the
+                    # SAME --precision restores directly; an f32-era
+                    # checkpoint restored into a master policy is adopted
+                    # (restored f32 params become the master, their
+                    # downcast the stored params — precision.py)
+                    from distributed_tensorflow_tpu.parallel import (
+                        precision as precisionlib)
+
+                    trainer.state = precisionlib.restore_into_policy(
+                        ckpt_mgr, template, ex.engine.precision)
                 except Exception as e:
                     # the most common structure mismatch here is a --health
                     # toggle across the resume boundary: enable_health
@@ -1383,11 +1458,14 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                     raise ValueError(
                         f"--resume could not restore the checkpoint under "
                         f"{config.checkpoint_dir} into this run's state "
-                        f"layout (--health {config.health}).  If the "
-                        f"checkpointed run used a different --health "
-                        f"setting, the optimizer tree differs (the health "
-                        f"capture slots live in it) — resume with the "
-                        f"original setting.  Original error: "
+                        f"layout (--health {config.health}, --precision "
+                        f"{config.precision}).  If the checkpointed run "
+                        f"used a different --health setting, the optimizer "
+                        f"tree differs (the health capture slots live in "
+                        f"it) — resume with the original setting.  An f32 "
+                        f"checkpoint restores into a master --precision "
+                        f"policy automatically; other precision crossings "
+                        f"need the original policy.  Original error: "
                         f"{type(e).__name__}: {e}") from e
                 sink.emit("resumed", step=ckpt_mgr.latest_step())
 
@@ -1494,6 +1572,7 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                              if config.pipeline_parallel > 1 else None),
             "global_batch": global_batch,
             "epochs": config.epochs,
+            "precision": fit.get("precision", config.precision),
             "steps": fit["steps"],
             # resolved steady-state drain shape (auto may downshift to 1)
             "steps_per_call": fit.get("steps_per_call"),
@@ -1762,8 +1841,18 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
             and config.serve_slots
             % ex.mesh.shape.get(meshlib.DATA_AXIS, 1) == 0):
         mesh = ex.mesh
+    kv_dtype = None
+    if config.serve_kv_dtype:
+        from distributed_tensorflow_tpu import models as modellib
+
+        # --serve-kv-dtype bfloat16: store the KV slot table in bf16 —
+        # half the KV memory per slot (double the slots per chip at equal
+        # HBM); greedy tokens stay oracle-exact on the shipped models
+        # (tests/test_serving.py), the attention math still runs at the
+        # model's compute dtype via promotion
+        kv_dtype = modellib.resolve_dtype(config.serve_kv_dtype)
     kv = SlotKVCache(ex.engine.model, params, config.serve_slots,
-                     mesh=mesh)
+                     mesh=mesh, kv_dtype=kv_dtype)
     rows = np.asarray(test_ds.x, np.int32)
     plen = config.serve_prompt_len
     requests = [

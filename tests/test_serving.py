@@ -421,6 +421,91 @@ def test_serve_section_per_chip_normalization():
     assert serve_section(None) is None
 
 
+# ------------------------------------------------------------ KV dtype
+
+def test_bf16_kv_cache_matches_sequential_oracle(model_params):
+    """--serve-kv-dtype bfloat16 (ISSUE 8 satellite): the KV slot table
+    stored in bf16 — half the KV memory — still decodes greedy tokens
+    identical to the sequential f32 ``generate`` oracle on the test
+    model, through staggered-age slots (the attention read promotes the
+    bf16 table back to the compute dtype)."""
+    import jax.numpy as jnp
+
+    model, params = model_params
+    kv = SlotKVCache(model, params, slots=4, kv_dtype=jnp.bfloat16)
+    assert kv.kv_dtype == "bfloat16"
+    f32_bytes = sum(
+        leaf.size * 4 for leaf in jax.tree.leaves(
+            SlotKVCache(model, params, slots=4).cache)
+        if jnp.issubdtype(leaf.dtype, jnp.floating))
+    bf16_bytes = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(kv.cache)
+        if jnp.issubdtype(leaf.dtype, jnp.floating))
+    assert bf16_bytes * 2 == f32_bytes  # half the KV memory per slot
+
+    prompts = _prompts(3, seed=11)
+    firsts = {}
+
+    def collect(toks):
+        for _, (slot, got) in firsts.items():
+            got.append(int(toks[slot]))
+
+    for i, p in enumerate(prompts):
+        slot, first = kv.insert(p)
+        firsts[i] = (slot, [first])
+        collect(kv.advance())
+    for _ in range(3):
+        collect(kv.advance())
+    for i, p in enumerate(prompts):
+        n = len(firsts[i][1])
+        np.testing.assert_array_equal(_oracle(model, params, p, n),
+                                      np.asarray(firsts[i][1]), str(i))
+
+
+def test_kv_dtype_surfaces_in_serve_summary(model_params):
+    import jax.numpy as jnp
+
+    model, params = model_params
+    kv = SlotKVCache(model, params, slots=2, kv_dtype=jnp.bfloat16)
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=3, arrival_s=0.0)
+            for i, p in enumerate(_prompts(2, seed=5))]
+    summary = ContinuousBatcher(kv).run(reqs)
+    assert summary["serve_kv_dtype"] == "bfloat16"
+    from distributed_tensorflow_tpu.observability import serve_section
+
+    assert serve_section(summary, 1)["serve_kv_dtype"] == "bfloat16"
+    # default table reports the model dtype
+    kv32 = SlotKVCache(model, params, slots=2)
+    summary32 = ContinuousBatcher(kv32).run(
+        [Request(rid=0, prompt=_prompts(1, seed=6)[0], max_new_tokens=2,
+                 arrival_s=0.0)])
+    assert summary32["serve_kv_dtype"] == "float32"
+
+
+def test_harness_serve_kv_dtype_e2e():
+    """--serve-kv-dtype threads through the harness into the serve
+    report section."""
+    from distributed_tensorflow_tpu.data.loaders import load_lm_dataset
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    def lm_fn(batch_size, type="train", **kw):
+        return load_lm_dataset(seq_len=16, vocab_size=64, n_train=64,
+                               n_test=32, split=type)
+
+    summary = run(ExperimentConfig(
+        engine="fsdp", model="gpt", dataset="lm_synth", dataset_fn=lm_fn,
+        n_devices=8, batch_size=4, log_every=0,
+        model_args={"hidden": 32, "layers": 1, "heads": 2, "ffn": 64,
+                    "max_len": 32},
+        serve_requests=4, serve_slots=8, serve_max_new=4,
+        serve_prompt_len=4, serve_kv_dtype="bfloat16"))
+    assert summary["serve"]["serve_kv_dtype"] == "bfloat16"
+    assert summary["run_report"]["serve"]["serve_kv_dtype"] == "bfloat16"
+    assert summary["serve"]["completed"] == 4
+
+
 # --------------------------------------------------------- harness + bench
 
 
