@@ -268,7 +268,7 @@ def _bench_checkpointing(fit_kw: dict, checkpoint_every: int):
     must not leak TrainState checkpoints under /tmp or a live writer
     thread.  No-op when ``checkpoint_every`` is 0."""
     if not checkpoint_every:
-        yield
+        yield None
         return
     import shutil
     import tempfile
@@ -281,12 +281,47 @@ def _bench_checkpointing(fit_kw: dict, checkpoint_every: int):
     fit_kw.update(checkpoint_manager=ckpt_mgr,
                   checkpoint_every=checkpoint_every)
     try:
-        yield
+        yield ckpt_mgr
     finally:
         # reraise=False: fit's own final drain already surfaced writer
         # errors on the normal path; the failure path must not mask
         ckpt_mgr.close(reraise=False)
         shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def _probe_elastic_resume(ckpt_mgr, eng, sample_x, *, seed: int,
+                          batch_size: int, dataset_len: int,
+                          dataset: str):
+    """Elastic resume probe (--checkpoint-every): restore the benched
+    window's last checkpoint through the elastic restore path
+    (elastic/reshard.py) and account the resume exactly the way a real
+    preempted relaunch would — ``preemption_lost_s`` is the save→resume
+    wall gap and ``resume_replay_steps`` is 0 iff the checkpoint's data
+    state describes the benched stream (an exactly-once resume), else
+    the restored step count (everything would replay).  These ride the
+    bench line next to the checkpoint split, gated lower-is-better by
+    `analyze diff` like the run report's copies.  Any failure Nones the
+    keys — a probe must never kill the bench line."""
+    import jax
+
+    from distributed_tensorflow_tpu import elastic as elasticlib
+
+    try:
+        template = eng.init_state(jax.random.key(0), sample_x)
+        state, extra = elasticlib.elastic_restore(ckpt_mgr, eng, template)
+        step = int(np.asarray(jax.device_get(state.step)).reshape(-1)[0])
+        ds_state = elasticlib.DataState.from_json(
+            (extra or {}).get("data_state"))
+        exact = ds_state is not None and ds_state.matches(
+            seed=seed, batch_size=batch_size, dataset_len=dataset_len,
+            dataset=dataset)
+        return {"preemption_lost_s": elasticlib.preemption_lost_s(extra),
+                "resume_replay_steps": 0 if exact else step,
+                "restored_step": step}
+    except Exception as e:  # noqa: BLE001 — the probe must not kill the bench
+        print(f"[bench] elastic resume probe failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -443,6 +478,7 @@ def bench_throughput(grad_compression: str = "none",
     dispatch_steps = min(DISPATCH_STEPS, len(ds.x) // global_batch)
     dispatch_rates = []
     last_fit = {}
+    elastic_probe = None
     if dispatch_steps:
         trainer = Trainer(None, engine=eng, seed=0)
         trainer.state = state
@@ -455,7 +491,7 @@ def bench_throughput(grad_compression: str = "none",
             fit_box["fit"] = fit
             return fit["examples"] / fit["elapsed"]
 
-        with _bench_checkpointing(fit_kw, checkpoint_every):
+        with _bench_checkpointing(fit_kw, checkpoint_every) as ckpt_mgr:
             try:
                 trainer.fit(ds, **fit_kw)  # warm: compiles the k=8 drain
             except Exception as e:  # noqa: BLE001 — scan row still emits
@@ -464,6 +500,13 @@ def bench_throughput(grad_compression: str = "none",
             else:
                 dispatch_rates = measure_windows(
                     _dispatch_window, REPEATS, "dispatch", partial_errors)
+            if ckpt_mgr is not None and dispatch_rates:
+                # while the manager (and its checkpoints) still exist:
+                # the elastic resume accounting of the benched window
+                elastic_probe = _probe_elastic_resume(
+                    ckpt_mgr, eng, x[:n], seed=trainer.seed,
+                    batch_size=global_batch, dataset_len=len(ds),
+                    dataset=getattr(ds, "name", "dataset"))
         last_fit = fit_box.get("fit", {})
         state = trainer.state
 
@@ -555,6 +598,12 @@ def bench_throughput(grad_compression: str = "none",
                 last_fit.get("checkpoint_overlapped_s"),
             "checkpoint_async": last_fit.get("checkpoint_async")}
            if checkpoint_every else {}),
+        # elastic resume accounting of the checkpointed window (the
+        # _probe_elastic_resume restore-and-account pass): save→resume
+        # wall gap + replay steps, the same keys the run report carries —
+        # gated lower-is-better by `analyze diff` (BASELINE.md
+        # "Preemption accounting")
+        **(elastic_probe or {}),
         # numeric-health summary of the Trainer-path window (--health on):
         # the same section the fit result / run report carry
         **({"health_max_update_ratio":

@@ -320,6 +320,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "format, restorable either way")
     p.add_argument("--resume", action="store_true",
                    help="restore the latest checkpoint before training")
+    p.add_argument("--elastic-restore", action="store_true",
+                   help="mesh-shape-independent resume (elastic/"
+                        "reshard.py): restore the latest checkpoint onto "
+                        "THIS run's mesh whatever mesh wrote it — device "
+                        "count and axis layout may both differ within the "
+                        "GSPMD engine family — continue the exact batch "
+                        "sequence from the checkpoint's data state "
+                        "(exactly-once resume; a pre-elastic checkpoint "
+                        "restarts the stream with a resume_replay_steps "
+                        "warning), and report preemption_lost_s / "
+                        "resume_replay_steps in the run report (gated by "
+                        "`analyze diff`)")
+    p.add_argument("--max-steps-per-lease", type=int, default=0,
+                   metavar="N",
+                   help="graceful lease drain (elastic/lease.py): stop at "
+                        "the first chunk boundary at/after N steps, write "
+                        "the final checkpoint (data state included) and "
+                        "exit with a structured `preempted` report "
+                        "section — relaunch with --elastic-restore to "
+                        "continue.  Checkpointed runs also drain on "
+                        "SIGTERM (the scheduler's preemption notice) "
+                        "whether or not N is set.  Requires "
+                        "--checkpoint-dir")
     p.add_argument("--metrics-path", "--metrics", default=None,
                    dest="metrics_path",
                    help="per-step metrics JSONL path (async crash-durable "
@@ -487,6 +510,8 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         checkpoint_every=args.checkpoint_every,
         async_checkpoint=args.async_checkpoint == "on",
         resume=args.resume,
+        elastic_restore=args.elastic_restore,
+        max_steps_per_lease=args.max_steps_per_lease,
         metrics_path=args.metrics_path,
         trace_path=args.trace,
         profile_dir=args.profile_dir,

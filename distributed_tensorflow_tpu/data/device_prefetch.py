@@ -61,6 +61,12 @@ class DevicePrefetch:
         self._buf: collections.deque = collections.deque()
         self.starvation = 0
         self.fill_wait_s = 0.0
+        # batches handed to the CONSUMER (not pulled from the source): the
+        # exactly-once resume position — batches still staged in the
+        # buffer were read ahead but never trained on, so a checkpointed
+        # data state built from `consumed` (elastic/data_state.py
+        # consumer_state) replays none of them and drops none either
+        self.consumed = 0
         self._fill()  # constructor prefill is not consumer wait time
         self.fill_wait_s = 0.0
 
@@ -84,6 +90,7 @@ class DevicePrefetch:
         if not self._buf:
             raise StopIteration
         out = self._buf.popleft()
+        self.consumed += 1
         if not self._buf and self._source is not None:
             # nothing staged ahead of the batch just handed out: the next
             # transfer starts cold instead of overlapping compute
@@ -107,7 +114,8 @@ class DevicePrefetch:
         """Gauge snapshot for the run report / trace timeline."""
         return {"depth": self._depth, "queue_depth": len(self._buf),
                 "starvation": self.starvation,
-                "fill_wait_s": self.fill_wait_s}
+                "fill_wait_s": self.fill_wait_s,
+                "consumed": self.consumed}
 
     def take(self, n: int) -> list:
         """Up to ``n`` next batches (fewer at exhaustion, [] when done) —

@@ -76,7 +76,7 @@ class Dataset:
 
     def batches(self, batch_size: int | None = None, *, shuffle: bool = True,
                 seed: int = 0, epoch: int = 0, drop_remainder: bool = False,
-                native: bool | None = None):
+                native: bool | None = None, start_batch: int = 0):
         """Iterate (x, y, mask) batches for one epoch.
 
         ``native=None`` (default) uses the C++ prefetching pipeline when the
@@ -89,12 +89,27 @@ class Dataset:
         same-size (x, y, mask) batches plus ``close()`` for early release —
         what data.device_prefetch wraps to stage batches on device ahead
         of the training loop.
+
+        ``start_batch`` > 0 resumes the epoch at its N-th batch (elastic
+        restore, elastic/data_state.py): the shuffle permutation depends
+        only on (seed, epoch), so the resumed stream continues the exact
+        batch sequence the uninterrupted epoch would have produced.  The
+        C++ pipeline stages from batch 0 only, so a mid-epoch resume takes
+        the Python path (byte-identical batches either way); ``native=True``
+        is rejected rather than silently replaying the skipped prefix.
         """
         from distributed_tensorflow_tpu.data.pipeline import iter_batches
 
         bs = batch_size or self.batch_size
         if bs is None:
             raise ValueError("batch_size not set; pass it or use with_batching()")
+        if start_batch:
+            if native:
+                raise RuntimeError(
+                    "the native pipeline has no mid-epoch resume (its C++ "
+                    "cursor starts at batch 0); start_batch > 0 requires "
+                    "the Python path")
+            native = False
         if getattr(self.y, "ndim", 1) > 1:
             # the C++ gather stages SCALAR labels (native/batcher.py fills
             # a (batch,) int32 buffer): an LM dataset's (B, L) next-token
@@ -118,7 +133,7 @@ class Dataset:
                     raise
         return iter_batches(
             self.x, self.y, bs, shuffle=shuffle, seed=seed, epoch=epoch,
-            drop_remainder=drop_remainder,
+            drop_remainder=drop_remainder, start_batch=start_batch,
         )
 
     def _native_batcher(self, batch_size: int):

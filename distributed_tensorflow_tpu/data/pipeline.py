@@ -20,6 +20,14 @@ native batcher's epoch iterator uses it to free its busy claim).  Consumers
 that read AHEAD of the training loop — data.device_prefetch, which stages
 batches on device so transfer overlaps compute — rely on exactly this
 surface and must call ``close()`` when stopping early.
+
+Elastic resume extends the contract two ways (elastic/data_state.py):
+``start_batch`` skips the first N batches of an epoch WITHOUT changing the
+epoch's shuffle permutation — a stream resumed at ``start_batch=N``
+continues the identical batch sequence the uninterrupted epoch would have
+produced from its N-th batch on — and producers MAY expose
+``state() -> DataState`` reporting their (epoch, batch) position
+(``ResumableBatches`` is the reference implementation).
 """
 
 from __future__ import annotations
@@ -40,13 +48,19 @@ def iter_batches(
     seed: int = 0,
     epoch: int = 0,
     drop_remainder: bool = False,
+    start_batch: int = 0,
 ) -> Iterator[Batch]:
+    if start_batch < 0:
+        raise ValueError(f"start_batch must be >= 0, got {start_batch}")
     n = len(x)
     idx = np.arange(n)
     if shuffle:
+        # the permutation depends only on (seed, epoch) — never on
+        # start_batch — so a resumed stream yields exactly the batches the
+        # uninterrupted epoch would have yielded from start_batch on
         rng = np.random.default_rng((seed, epoch))
         rng.shuffle(idx)
-    for start in range(0, n, batch_size):
+    for start in range(start_batch * batch_size, n, batch_size):
         take = idx[start : start + batch_size]
         if len(take) < batch_size:
             if drop_remainder:

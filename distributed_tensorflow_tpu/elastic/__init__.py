@@ -1,0 +1,46 @@
+"""Elastic preemption-tolerant training: a run as a resumable,
+mesh-shape-independent object.
+
+Four pillars over PR 5's atomic async checkpoints (ROADMAP item 3):
+
+* **Resharding restore** (``reshard.py``): restore a checkpoint onto a
+  different mesh shape — device count AND axis layout — within the GSPMD
+  engine family, precision-policy-aware, re-placed under the target
+  engine's spec map.
+* **Exactly-once data resume** (``data_state.py``): the batch iterator's
+  (epoch, offset, seed) position rides each checkpoint as the elastic
+  sidecar; resume continues the identical batch sequence, prefetch
+  read-ahead drained/discounted.
+* **Graceful lease drain** (``lease.py``): ``--max-steps-per-lease`` and
+  a SIGTERM preemption-notice handler finish the in-flight chunk, write
+  a final checkpoint and exit with a structured ``preempted`` report
+  section.
+* **Straggler detection + preemption accounting** (``stragglers.py``,
+  ``reshard.preemption_lost_s``): step-time outliers as structured
+  ``straggler`` trace events; ``preemption_lost_s`` /
+  ``resume_replay_steps`` as first-class, ``analyze diff``-gated numbers
+  (MLPerf time-to-quality framing, PAPERS.md).
+"""
+
+from distributed_tensorflow_tpu.elastic.data_state import (  # noqa: F401
+    DATA_STATE_VERSION, DataState, ResumableBatches, consumer_state)
+from distributed_tensorflow_tpu.elastic.lease import (  # noqa: F401
+    LeaseManager)
+from distributed_tensorflow_tpu.elastic.reshard import (  # noqa: F401
+    ElasticRestoreError, elastic_restore, place_under_spec_map,
+    preemption_lost_s)
+from distributed_tensorflow_tpu.elastic.stragglers import (  # noqa: F401
+    StragglerDetector)
+
+__all__ = [
+    "DATA_STATE_VERSION",
+    "DataState",
+    "ResumableBatches",
+    "consumer_state",
+    "LeaseManager",
+    "ElasticRestoreError",
+    "elastic_restore",
+    "place_under_spec_map",
+    "preemption_lost_s",
+    "StragglerDetector",
+]

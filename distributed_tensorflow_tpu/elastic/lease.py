@@ -1,0 +1,120 @@
+"""Graceful lease drain: preemption as a planned exit, not a corpse.
+
+Production TPU leases end two ways — a preemption notice (SIGTERM from
+the scheduler, typically ~30 s before the kill) or a known budget
+("this reservation is ours for N steps' worth of wall time").  Both map
+to the same drain: finish the in-flight scan chunk, write a final async
+checkpoint (with its data state), drain the writer, and return a result
+whose ``preempted`` field names why — the harness then emits a
+structured ``preempted`` run-report section and exits cleanly, so the
+relaunch (``--elastic-restore``) continues the run exactly where the
+lease ended.
+
+:class:`LeaseManager` packages both triggers behind the ONE hook
+``Trainer.fit`` checks at chunk boundaries (``should_stop``): a signal
+handler that flips a flag (signal-safe: the handler does nothing but
+assign) and a per-lease step budget (``--max-steps-per-lease``).  The
+drain composes with ``steps_per_call > 1`` by construction — the hook is
+only consulted where boundary state exists, so a preemption notice
+mid-chunk lets the chunk finish (seconds) rather than abandoning it.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Any
+
+
+def _signal_name(signum: int | None) -> str | None:
+    """Human name of a signal number ('SIGTERM'); the raw number as a
+    string for values the platform's Signals enum does not know."""
+    if signum is None:
+        return None
+    try:
+        return signal.Signals(signum).name
+    except ValueError:
+        return str(signum)
+
+
+class LeaseManager:
+    """SIGTERM/step-budget preemption trigger for ``Trainer.fit``'s
+    ``should_stop`` hook.
+
+    ``install()`` arms the signal handlers (main thread only — Python
+    restricts ``signal.signal`` to it; elsewhere the manager degrades to
+    the step budget alone and says so in ``report()``), saving the
+    previous dispositions for ``uninstall()``.  The handler only sets a
+    flag: the actual drain happens on the training thread at the next
+    chunk boundary, where a consistent boundary state exists to
+    checkpoint.
+    """
+
+    def __init__(self, max_steps_per_lease: int = 0,
+                 signals: tuple[int, ...] = (signal.SIGTERM,)):
+        if max_steps_per_lease < 0:
+            raise ValueError(
+                f"max_steps_per_lease must be >= 0 (0 disables the step "
+                f"budget), got {max_steps_per_lease}")
+        self.max_steps_per_lease = int(max_steps_per_lease)
+        self._signals = tuple(signals)
+        self._prev: dict[int, Any] = {}
+        self.installed = False
+        self.was_installed = False  # sticky: survives uninstall(), so a
+        # report() taken after the run's teardown still records that the
+        # handler WAS armed while training ran
+        self.preempt_signal: int | None = None
+
+    # ----------------------------------------------------------- signals
+    def _on_signal(self, signum, frame) -> None:  # noqa: ARG002
+        # async-signal-safe by doing nothing but an assignment; the
+        # training thread reads the flag at its next boundary
+        self.preempt_signal = signum
+
+    def install(self) -> "LeaseManager":
+        if threading.current_thread() is not threading.main_thread():
+            return self  # step budget still works; report() records it
+        try:
+            for s in self._signals:
+                self._prev[s] = signal.signal(s, self._on_signal)
+            self.installed = True
+            self.was_installed = True
+        except (ValueError, OSError):  # embedded interpreters etc.
+            self.installed = False
+        return self
+
+    def uninstall(self) -> None:
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+        self.installed = False
+
+    def __enter__(self) -> "LeaseManager":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -------------------------------------------------------------- hook
+    def should_stop(self, steps_done: int) -> str | None:
+        """The ``Trainer.fit(should_stop=)`` hook: a reason string when
+        the lease is over (preemption notice received, or ``steps_done``
+        this fit reached the per-lease budget), else None."""
+        if self.preempt_signal is not None:
+            return f"signal:{_signal_name(self.preempt_signal)}"
+        if (self.max_steps_per_lease
+                and steps_done >= self.max_steps_per_lease):
+            return f"max_steps_per_lease:{self.max_steps_per_lease}"
+        return None
+
+    def report(self) -> dict[str, Any]:
+        """Run-report fodder: what the lease was armed with and whether a
+        preemption notice arrived."""
+        return {
+            "max_steps_per_lease": self.max_steps_per_lease or None,
+            "signal_handler_installed": self.was_installed,
+            "preempt_signal": _signal_name(self.preempt_signal),
+        }

@@ -1,0 +1,130 @@
+"""Resharding restore: a checkpoint as a mesh-shape-independent object.
+
+A checkpoint written by PR 5's managers stores FULL host arrays — Orbax
+gathers sharded leaves transparently at save, so nothing on disk encodes
+the mesh the run trained on.  What pinned restore to the same device
+count was the restore path, not the format: nobody re-derived placements
+for a different target.  This module closes that gap for the GSPMD
+engine family (sync/allreduce, fsdp, tensor-parallel and the composite
+axis layouts): restore loads each leaf into the TARGET engine's template
+via the policy-aware machinery of ``parallel/precision.py`` (an f32-era
+checkpoint adopts into a master policy exactly as on a fixed mesh), then
+re-places every leaf under the partition spec the target engine's spec
+map (``Engine.state_partition_specs``) assigns it on the NEW mesh —
+replicated leaves replicate, fsdp leaves shard over the new 'data' axis,
+Megatron leaves land on the new 'model' axis, and a precision policy's
+f32 master copies inside ``opt_state`` reshard with the params they
+mirror.  Device count and axis layout may both change; only the GLOBAL
+shapes must match, which for the GSPMD family they do by construction.
+
+Out of scope, by design: the per-device-STACKED engines (async local
+SGD, gossip) carry one model replica per device as a leading state axis,
+so their global shapes change with the device count — a cross-count
+restore of divergent local replicas has no unique answer (consensus
+averaging is a research choice, not a restore).  They restore onto the
+count they were saved from; the error below names this instead of
+surfacing a raw shape mismatch.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel import precision as precisionlib
+
+
+class ElasticRestoreError(RuntimeError):
+    """A checkpoint could not be restored into the target engine's layout
+    (shape/structure mismatch beyond what resharding can bridge)."""
+
+
+class _StepPinned:
+    """Adapter pinning ``restore`` to one step so the policy-aware restore
+    helpers (which take only a manager) can restore a non-latest step."""
+
+    def __init__(self, manager, step: int):
+        self._manager, self._step = manager, step
+
+    def restore(self, template: Any) -> Any:
+        return self._manager.restore(template, self._step)
+
+
+def place_under_spec_map(state: Any, specs: Any, mesh) -> Any:
+    """Re-place every array leaf of ``state`` under ``NamedSharding(mesh,
+    spec)`` of its entry in ``specs`` (an ``Engine.state_partition_specs``
+    tree).  The explicit resharding step of an elastic restore: leaves a
+    same-mesh restore untouched (device_put to the current sharding is the
+    identity) and moves cross-mesh leaves onto the new layout.  Leaves
+    that are not mesh-placed to begin with (a pure-jit engine's
+    single-device arrays, host scalars) are left alone — forcing them
+    onto a mesh would CHANGE the engine's execution semantics, not
+    restore them."""
+    def place(leaf, spec):
+        if (isinstance(leaf, jax.Array) and isinstance(spec, P)
+                and isinstance(getattr(leaf, "sharding", None),
+                               NamedSharding)):
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return leaf
+
+    # mapping over (state, specs): state's array leaves drive the flatten,
+    # so each P entry of the spec tree arrives whole as `spec`
+    return jax.tree.map(place, state, specs)
+
+
+def elastic_restore(manager, engine, template: Any, *,
+                    step: int | None = None) -> tuple[Any, dict | None]:
+    """Restore a checkpoint onto ``engine``'s mesh, whatever mesh wrote it.
+
+    ``template`` is a fresh ``engine.init_state`` product — it fixes the
+    target structure, dtypes (via the engine's precision policy) and spec
+    map.  Returns ``(state, extra)``: the restored TrainState placed under
+    the target spec map, and the checkpoint's elastic sidecar (data state
+    + save wall time; ``None`` for checkpoints that predate it —
+    utils/checkpoint.py ``load_extra``).
+
+    Precision crossings follow ``precision.restore_into_policy``: same
+    policy restores directly, an f32-era checkpoint adopts into a master
+    policy (restored f32 params become the master); other crossings raise.
+    """
+    policy = getattr(engine, "precision", None)
+    if policy is None:
+        policy = precisionlib.make_policy("f32")
+    source = manager if step is None else _StepPinned(manager, step)
+    try:
+        state = precisionlib.restore_into_policy(source, template, policy)
+    except Exception as e:
+        mesh_shape = dict(engine.mesh.shape)
+        raise ElasticRestoreError(
+            f"elastic restore could not load the checkpoint under "
+            f"{manager.directory} into this run's layout (target mesh "
+            f"{mesh_shape}, precision '{policy.name}').  Cross-mesh "
+            f"restore covers the GSPMD engine family (sync/allreduce, "
+            f"fsdp, tensor-parallel and their composites), whose global "
+            f"state shapes are mesh-independent; the per-device-stacked "
+            f"engines (async/gossip) restore only onto the device count "
+            f"they were saved from, and precision crossings other than "
+            f"f32 → a master policy need the original --precision.  A "
+            f"--health toggle across the resume boundary also changes the "
+            f"optimizer tree (capture slots).  Original error: "
+            f"{type(e).__name__}: {e}") from e
+    specs = engine.state_partition_specs(template)
+    state = place_under_spec_map(state, specs, engine.mesh)
+    extra = manager.load_extra(step)
+    return state, extra
+
+
+def preemption_lost_s(extra: dict | None,
+                      now: float | None = None) -> float | None:
+    """Seconds between the restored checkpoint's save and this resume —
+    the MLPerf time-to-quality cost of the preemption (nothing trained in
+    that window counts; BASELINE.md "Preemption accounting").  ``None``
+    when the checkpoint carries no save wall time (older builds) — "not
+    measured" stays distinguishable from a measured 0."""
+    wall = (extra or {}).get("wall_time")
+    if not isinstance(wall, (int, float)) or isinstance(wall, bool):
+        return None
+    return max((time.time() if now is None else now) - float(wall), 0.0)

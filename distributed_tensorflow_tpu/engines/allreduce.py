@@ -119,7 +119,10 @@ class Trainer:
             target_accuracy: float | None = None, eval_every: int = 50,
             eval_batch: int = 100, steps_per_call: int | None = None,
             prefetch: int = 2, tracer=None,
-            on_anomaly: str = "warn") -> dict:
+            on_anomaly: str = "warn",
+            should_stop: Callable[[int], str | None] | None = None,
+            data_state: dict | None = None,
+            straggler_detector=None) -> dict:
         """Train; returns {'elapsed': seconds_around_fit, 'steps': n, ...} —
         the reference's only training metrics (reference dist_keras.py:41-49).
 
@@ -161,6 +164,28 @@ class Trainer:
         figure (BASELINE.md north star) has ≤10-step resolution without
         paying full-eval cost on every step.  The result then carries
         ``reached_target`` and ``eval_accuracy``.
+
+        Elastic hooks (distributed_tensorflow_tpu/elastic/):
+        ``should_stop(steps_done) -> reason | None`` is consulted at every
+        chunk boundary (each step at k=1) — a truthy reason finishes the
+        in-flight chunks, writes the final checkpoint (data state
+        included) and returns with ``result['preempted'] = reason``: the
+        graceful lease drain, composing with ``steps_per_call > 1`` by
+        construction.  ``data_state`` (a checkpoint's elastic sidecar
+        payload, possibly ``{}``) positions the batch stream for an
+        exactly-once resume: a matching state continues the identical
+        batch sequence at its (epoch, batch) and the result reports
+        ``resume_replay_steps = 0``; a missing/mismatched state restarts
+        the stream from epoch 0 and reports the unrecoverable positions
+        (``resume_replay_steps = start_step``) — pass ``None`` (default)
+        for the legacy non-elastic resume with no accounting.  Every
+        checkpoint this fit writes carries its own data state + save wall
+        time as the elastic sidecar, read-ahead drained/discounted (the
+        position is the step counter, never the prefetch producer).
+        ``straggler_detector`` (elastic.StragglerDetector) observes the
+        per-chunk step times the loop already measures and emits
+        structured ``straggler`` trace events on outliers; its summary
+        rides the result as ``stragglers``.
 
         Steady state: host batches are staged onto the mesh ``prefetch``
         batches ahead (data/device_prefetch.py — transfer N+1 overlaps
@@ -339,6 +364,36 @@ class Trainer:
         # instead of restarting at 1
         # (.reshape(-1)[0]: async engine's step is per-device, one per shard)
         start_step = int(np.asarray(jax.device_get(self.state.step)).reshape(-1)[0])
+        # exactly-once data resume (elastic/data_state.py): a restored
+        # checkpoint's data state positions the batch stream at the exact
+        # (epoch, batch) the saved step had consumed, so the resumed run
+        # continues the IDENTICAL batch sequence — None (default) keeps
+        # the legacy resume (stream restarts at epoch 0, no accounting);
+        # a dict that fails to match this run's seed/batch-size/dataset
+        # falls back to the same restart but REPORTS the unrecoverable
+        # positions as resume_replay_steps
+        start_epoch = 0
+        start_batch = 0
+        replay_steps = None
+        if data_state is not None:
+            from distributed_tensorflow_tpu.elastic.data_state import (
+                DataState)
+
+            restored_ds = DataState.from_json(data_state)
+            if restored_ds is not None and restored_ds.matches(
+                    seed=self.seed, batch_size=local_bs,
+                    dataset_len=len(train_ds),
+                    dataset=getattr(train_ds, "name", "dataset")):
+                start_epoch, start_batch = (restored_ds.epoch,
+                                            restored_ds.batch_index)
+                replay_steps = 0
+            else:
+                replay_steps = start_step
+                if start_step:
+                    log_fn(f"elastic resume: checkpoint carries no "
+                           f"matching data state — the batch stream "
+                           f"restarts from epoch 0 "
+                           f"(resume_replay_steps={start_step})")
         # async checkpoint discipline (utils/checkpoint.py
         # AsyncCheckpointManager): saves cost the training thread a device
         # snapshot; the write overlaps the next chunks on a background
@@ -352,34 +407,63 @@ class Trainer:
         # managers outlive fits (bench reuses one): report THIS fit's
         # overlapped seconds, not the manager's lifetime total
         ckpt_overlap0 = getattr(checkpoint_manager, "overlapped_s", 0.0)
+        # batch-stream position of the CURRENT epoch, maintained by the
+        # epoch loop: cur_epoch's stream started at epoch_offset and
+        # epoch_base was the step counter then, so the boundary position
+        # is epoch_offset + (steps - epoch_base) — the step counter, not
+        # the prefetch producer, which is how read-ahead gets discounted
+        cur_epoch = start_epoch
+        epoch_base = 0
+        epoch_offset = start_batch
+        last_data_state = None
+
+        def current_data_state() -> dict:
+            from distributed_tensorflow_tpu.elastic.data_state import (
+                DataState)
+
+            return DataState(
+                epoch=cur_epoch,
+                batch_index=epoch_offset + (steps - epoch_base),
+                seed=self.seed, batch_size=local_bs,
+                dataset_len=len(train_ds),
+                dataset=getattr(train_ds, "name", "dataset")).to_json()
 
         def do_checkpoint(step: int, final: bool = False) -> None:
             """One boundary checkpoint, both disciplines: sync blocks for
             the full write under a ``checkpoint`` span; async pays only
             the snapshot (+ any previous-write backpressure) under
             ``ckpt_snapshot`` — the final save additionally drains, so fit
-            never returns with a write in flight."""
-            nonlocal ckpt_wait, ckpt_last_step
+            never returns with a write in flight.  Every write carries
+            the elastic sidecar (data state + save wall time) that makes
+            the checkpoint a resumable object."""
+            nonlocal ckpt_wait, ckpt_last_step, last_data_state
             t0 = time.perf_counter()
             # the final boundary often IS the last cadence boundary (steps
             # divisible by checkpoint_every): that state is already saved
             # — or in flight — so re-writing it would only re-pay the full
             # write; the final call then just drains
             skip_write = final and step == ckpt_last_step
+            if not skip_write:
+                last_data_state = current_data_state()
+                extra = {"data_state": last_data_state,
+                         "wall_time": time.time(), "step": step,
+                         "schema": 1}
             # the boundary step is known here — passing it spares save()
             # its state.step device sync on the training thread
             if ckpt_async:
                 attrs = {"step": step, **({"final": True} if final else {})}
                 with tracer.span("ckpt_snapshot", **attrs):
                     if not skip_write:
-                        checkpoint_manager.save(self.state, step=step)
+                        checkpoint_manager.save(self.state, step=step,
+                                                extra=extra)
                     if final:
                         checkpoint_manager.wait()
             elif not skip_write:
                 with tracer.span("checkpoint", step=step,
                                  **({"final": True} if final else {})):
                     jax.block_until_ready(self.state)
-                    checkpoint_manager.save(self.state, step=step)
+                    checkpoint_manager.save(self.state, step=step,
+                                            extra=extra)
             ckpt_last_step = step
             ckpt_wait += time.perf_counter() - t0
 
@@ -455,6 +539,7 @@ class Trainer:
         eval_acc = 0.0
         reached = False
         stop = False
+        preempted = None     # should_stop's reason once the drain fires
         compiled = False     # first dispatch carries the XLA compile —
         chunk_sizes: set[int] = set()  # its span is named 'compile'
         pf_starvation = 0    # prefetch gauges accumulated across epochs
@@ -529,12 +614,19 @@ class Trainer:
         # never masks the original error: the drain runs reraise=False
         # and the flushes swallow their own failures.
         try:
-            for epoch in range(epochs):
+            for epoch in range(start_epoch, epochs):
                 if stop:
                     break
+                # mid-epoch resume: only the FIRST resumed epoch starts at
+                # the restored batch offset; the shuffle permutation is a
+                # function of (seed, epoch) alone, so the stream continues
+                # the exact sequence the uninterrupted run would have
+                ebatch = start_batch if epoch == start_epoch else 0
+                cur_epoch, epoch_base, epoch_offset = epoch, steps, ebatch
                 pf = DevicePrefetch(
                     train_ds.batches(local_bs, shuffle=True, seed=self.seed,
-                                     epoch=epoch, drop_remainder=True),
+                                     epoch=epoch, drop_remainder=True,
+                                     start_batch=ebatch),
                     place, depth=prefetch)
                 try:
                     if k == 1:
@@ -566,6 +658,12 @@ class Trainer:
                             steps += 1
                             gstep = start_step + steps
                             examples += bs  # global examples per step
+                            if straggler_detector is not None:
+                                # the amortized dispatch+throttle time just
+                                # appended — the k=1 rendering of the
+                                # per-chunk average the drain observes
+                                straggler_detector.observe(
+                                    gstep, timer.times[-1])
                             dev_metrics = metrics
                             if health_cfg is not None or ls_active:
                                 # the anomaly/loss-scale policy needs this
@@ -587,6 +685,15 @@ class Trainer:
                                     checkpoint_every and \
                                     gstep % checkpoint_every == 0:
                                 do_checkpoint(gstep)
+                            if should_stop is not None:
+                                # graceful drain: every step IS a chunk
+                                # boundary at k=1 — the final checkpoint
+                                # (data state included) runs at loop exit
+                                reason = should_stop(steps)
+                                if reason:
+                                    preempted = reason
+                                    stop = True
+                                    break
                             at_cap = max_steps is not None and steps >= max_steps
                             if eval_and_maybe_stop(steps - 1, at_cap):
                                 break
@@ -604,9 +711,13 @@ class Trainer:
                         # device always has queued work.  With state consumers,
                         # window 0: every chunk flushes eagerly at its boundary
                         # so checkpoint/eval see exactly the boundary state.
+                        # should_stop (the lease drain) is a chunk-boundary
+                        # STATE consumer too: its decision must see flushed
+                        # boundary state, so it forces the eager window
                         window = (self.max_in_flight
                                   if checkpoint_manager is None
-                                  and target_accuracy is None else 0)
+                                  and target_accuracy is None
+                                  and should_stop is None else 0)
                         in_flight_chunks: list = []  # (n_steps, t_disp, stacked)
                         t_mark = 0.0  # end of the previous flush (timing ref)
 
@@ -631,6 +742,12 @@ class Trainer:
                             dt = (now - max(t_disp, t_mark)) / n_chunk
                             t_mark = now
                             timer.times.extend([dt] * n_chunk)
+                            if straggler_detector is not None:
+                                # per-chunk average step time vs the
+                                # running median (elastic/stragglers.py);
+                                # labeled with the chunk's last step
+                                straggler_detector.observe(
+                                    start_step + steps + n_chunk, dt)
                             if watchdog is not None:
                                 # flush beat: real device progress confirmed
                                 # (the stall budget is k × per-step timeout —
@@ -698,6 +815,17 @@ class Trainer:
                                         > (start_step + chunk_start) // checkpoint_every:
                                     # first chunk boundary at/after the due step
                                     do_checkpoint(start_step + steps)
+                                if should_stop is not None:
+                                    # graceful drain at the chunk boundary:
+                                    # the in-flight chunk finished (it was
+                                    # just flushed); remaining dispatched
+                                    # chunks drain below and the final
+                                    # checkpoint runs at loop exit
+                                    reason = should_stop(steps)
+                                    if reason:
+                                        preempted = reason
+                                        stop = True
+                                        break
                                 at_cap = (max_steps is not None
                                           and steps >= max_steps)
                                 # evaluated at chunk boundaries (auto mode runs
@@ -707,6 +835,13 @@ class Trainer:
                         # epoch end (or early stop): drain the window in order
                         while in_flight_chunks:
                             flush_chunk()
+                        if not stop and should_stop is not None:
+                            # window > 0 fallback (no other state consumer):
+                            # the drained epoch end is still a boundary
+                            reason = should_stop(steps)
+                            if reason:
+                                preempted = reason
+                                stop = True
                         if max_steps is not None and steps >= max_steps:
                             stop = True
                 finally:
@@ -791,6 +926,21 @@ class Trainer:
                 "checkpoint_async": ckpt_async}
                if checkpoint_manager is not None else {}),
             **({"steps_per_call_clamp": spc_clamp} if spc_clamp else {}),
+            # graceful-drain outcome (elastic/lease.py): the should_stop
+            # reason when a lease ended the fit, None on a normal finish
+            "preempted": preempted,
+            # exactly-once resume accounting (only when this fit WAS an
+            # elastic resume — data_state given): steps whose data
+            # position could not be restored (0 = exact resume)
+            **({"resume_replay_steps": replay_steps}
+               if data_state is not None else {}),
+            # step-time outlier summary (elastic/stragglers.py)
+            **({"stragglers": straggler_detector.report()}
+               if straggler_detector is not None else {}),
+            # the batch-stream position of the LAST checkpoint written —
+            # what its elastic sidecar carries
+            **({"data_state": last_data_state}
+               if last_data_state is not None else {}),
             **({"watchdog_beats": watchdog.beats,
                 "watchdog_stalls": watchdog.stall_episodes}
                if watchdog is not None else {}),

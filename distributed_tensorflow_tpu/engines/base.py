@@ -512,6 +512,26 @@ class Engine:
                 monitor.observe(metrics["overflow"][i])
         return state, metrics
 
+    # ----------------------------------------------------------- spec map
+    def state_partition_specs(self, state: TrainState) -> PyTree:
+        """Per-leaf ``PartitionSpec`` tree of this engine's state layout —
+        the spec map elastic resharding restores a checkpoint under
+        (elastic/reshard.py): a leaf loaded from a checkpoint written on a
+        DIFFERENT mesh shape is re-placed as ``NamedSharding(self.mesh,
+        spec)`` of its entry here.  Derived from the live leaf shardings
+        of ``state`` (typically a fresh ``init_state`` template), so every
+        engine's layout — replicated, fsdp-sharded, tensor-parallel, and
+        a precision policy's master copies inside ``opt_state`` — is
+        covered by the one base implementation; leaves without a
+        ``NamedSharding`` (host scalars) map to replicated ``P()``."""
+        def spec_of(leaf):
+            sh = getattr(leaf, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                return sh.spec
+            return P()
+
+        return jax.tree.map(spec_of, state)
+
     # ----------------------------------------------------------- telemetry
     def grad_collective_bytes_raw(self, state: TrainState) -> int:
         """UNCOMPRESSED bytes one gradient collective round moves (the

@@ -277,6 +277,18 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     # fit result; overlapped_s is deliberately NOT compared — moving work
     # onto the background writer is the point, not a regression)
     ("checkpoint_wait_s", "lower"),
+    # elastic preemption accounting (run report of an --elastic-restore
+    # run; BASELINE.md "Preemption accounting"): wall seconds between the
+    # restored checkpoint's save and the resume — time nothing trained —
+    # and steps whose data-stream position could not be restored (0 = an
+    # exact exactly-once resume).  Both lower-is-better: a fatter
+    # preemption window or a lossier resume is a regression in
+    # time-to-quality even when throughput held.
+    ("preemption_lost_s", "lower"),
+    ("resume_replay_steps", "lower"),
+    # step-time outlier count (flattened from the stragglers section
+    # below): more outlier chunks at equal work = a degrading lease
+    ("straggler_events", "lower"),
     ("examples_per_sec", "higher"), ("examples_per_sec_per_device", "higher"),
     ("test_accuracy", "higher"),
     # bench.py line vocabulary ("value"'s direction is resolved per line —
@@ -339,6 +351,11 @@ def load_report(path: str | Path) -> dict[str, Any]:
     ls = flat.get("loss_scale")
     if isinstance(ls, dict) and "skipped_steps" in ls:
         flat.setdefault("loss_scale_skipped_steps", ls["skipped_steps"])
+    # the straggler section's outlier count surfaces flat (events = how
+    # many chunks exceeded factor × the running median step time)
+    stragglers = flat.get("stragglers")
+    if isinstance(stragglers, dict) and "events" in stragglers:
+        flat.setdefault("straggler_events", stragglers["events"])
     # a run report's nested `serve` section surfaces its serve_* metrics
     # at the top level so serving runs diff with the same machinery as
     # training runs (bench --serve lines already emit them flat)

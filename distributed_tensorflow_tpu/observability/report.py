@@ -143,6 +143,23 @@ def build_run_report(fit_result: dict[str, Any], *,
         "checkpoint_wait_s": fit_result.get("checkpoint_wait_s"),
         "checkpoint_overlapped_s": fit_result.get("checkpoint_overlapped_s"),
         "checkpoint_async": fit_result.get("checkpoint_async"),
+        # elastic preemption tolerance (distributed_tensorflow_tpu/
+        # elastic/): the graceful-drain outcome (the lease's should_stop
+        # reason, None on a normal finish), the resume-side accounting of
+        # an --elastic-restore run — preemption_lost_s (save → resume
+        # wall-clock gap, the MLPerf time-to-quality cost of the
+        # preemption) and resume_replay_steps (steps whose data position
+        # could not be restored; 0 = exact resume), both gated
+        # lower-is-better by `analyze diff` — plus the step the restore
+        # came from, the lease arming record and the straggler summary.
+        # None throughout when the run was not elastic — "not an elastic
+        # run" stays distinguishable from a measured 0.
+        "preempted": fit_result.get("preempted"),
+        "preemption_lost_s": fit_result.get("preemption_lost_s"),
+        "resume_replay_steps": fit_result.get("resume_replay_steps"),
+        "restored_step": fit_result.get("restored_step"),
+        "lease": fit_result.get("lease"),
+        "stragglers": fit_result.get("stragglers"),
     }
 
     report["watchdog"] = None if watchdog is None else {

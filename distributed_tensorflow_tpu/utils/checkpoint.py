@@ -29,6 +29,7 @@ the next manager start), never a half-written visible checkpoint.
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import queue
 import re
@@ -43,6 +44,15 @@ import orbax.checkpoint as ocp
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 _TMP_DIR = re.compile(r"^tmp_step_(\d+)$")
+
+# JSON sidecar riding INSIDE each step directory (Orbax ignores files it
+# did not write): the elastic payload — data-iterator state + save wall
+# time — that makes a checkpoint a resumable, exactly-once object
+# (elastic/data_state.py).  Written into tmp_step_N BEFORE the fsync +
+# rename, so the payload is atomic with the checkpoint itself: a visible
+# step_N either carries its sidecar or was written by an older build
+# (restore then degrades to replay accounting, never to a torn read).
+_EXTRA_FILE = "elastic.json"
 
 
 def _is_key(x) -> bool:
@@ -176,22 +186,28 @@ class CheckpointManager:
         return int(max(np.asarray(sh.data).max()
                        for sh in s.addressable_shards))
 
-    def _write(self, step: int, host_state: Any) -> None:
+    def _write(self, step: int, host_state: Any,
+               extra: dict | None = None) -> None:
         """Atomic visible write: Orbax into ``tmp_step_N``, fsync, rename
         to ``step_N``.  A crash anywhere before the rename leaves only the
-        ``tmp_`` directory — never a half-written ``step_N``."""
+        ``tmp_`` directory — never a half-written ``step_N``.  ``extra``
+        (the elastic sidecar) is written into the tmp directory, so it
+        becomes visible atomically with the checkpoint."""
         tmp = self.directory / f"tmp_step_{step}"
         final = self.directory / f"step_{step}"
         if tmp.exists():
             shutil.rmtree(tmp)
         self._ckptr.save(tmp, host_state, force=True)
+        if extra is not None:
+            (tmp / _EXTRA_FILE).write_text(json.dumps(extra))
         _fsync_tree(tmp)
         if final.exists():
             shutil.rmtree(final)
         os.replace(tmp, final)
         _fsync_dir(self.directory)
 
-    def save(self, state: Any, step: int | None = None) -> Path:
+    def save(self, state: Any, step: int | None = None,
+             extra: dict | None = None) -> Path:
         step = self._resolve_step(state, step)
         path = self.directory / f"step_{step}"
         state = _unkey(state)
@@ -203,11 +219,11 @@ class CheckpointManager:
 
             host_state = multihost_utils.process_allgather(state)
             if jax.process_index() == 0:
-                self._write(step, host_state)
+                self._write(step, host_state, extra)
                 self._retain()
             multihost_utils.sync_global_devices(f"ckpt_save_{step}")
         else:
-            self._write(step, jax.device_get(state))
+            self._write(step, jax.device_get(state), extra)
             self._retain()
         return path
 
@@ -237,6 +253,23 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         steps = self.steps()
         return steps[-1] if steps else None
+
+    def load_extra(self, step: int | None = None) -> dict | None:
+        """The elastic sidecar saved with ``step`` (latest when None):
+        data-iterator state + save wall time (elastic/data_state.py).
+        ``None`` when the checkpoint predates the sidecar (older builds) —
+        callers then fall back to replay accounting — or when the step
+        does not exist."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        path = self.directory / f"step_{step}" / _EXTRA_FILE
+        try:
+            payload = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            return None
+        return payload if isinstance(payload, dict) else None
 
     def restore(self, template: Any, step: int | None = None) -> Any:
         """Restore into the structure/shardings of ``template`` (a freshly
@@ -319,7 +352,7 @@ class AsyncCheckpointManager(CheckpointManager):
             job = self._queue.get()
             if job is None:
                 return
-            step, snapshot = job
+            step, snapshot, extra = job
             t0 = time.perf_counter()
             try:
                 span = (self.tracer.span("ckpt_write", step=step)
@@ -328,7 +361,7 @@ class AsyncCheckpointManager(CheckpointManager):
                 with span:
                     # the transfer was started by copy_to_host_async at
                     # snapshot time; device_get here mostly just collects
-                    self._write(step, jax.device_get(snapshot))
+                    self._write(step, jax.device_get(snapshot), extra)
                     self._retain()
             except BaseException as e:  # noqa: BLE001 — surfaced on the
                 self._error = e         # training thread at the next sync
@@ -357,9 +390,10 @@ class AsyncCheckpointManager(CheckpointManager):
         with self._acct_lock:
             self.overlapped_s = max(0.0, self.overlapped_s - seconds)
 
-    def save(self, state: Any, step: int | None = None) -> Path:
+    def save(self, state: Any, step: int | None = None,
+             extra: dict | None = None) -> Path:
         if jax.process_count() > 1:
-            return super().save(state, step)  # pod saves stay collective
+            return super().save(state, step, extra)  # pod saves stay collective
         step = self._resolve_step(state, step)
         t0 = time.perf_counter()
         self._idle.wait()  # backpressure: at most ONE save in flight
@@ -368,7 +402,7 @@ class AsyncCheckpointManager(CheckpointManager):
         snapshot = _snapshot(state)
         self._idle.clear()
         self._ensure_writer()
-        self._queue.put((step, snapshot))
+        self._queue.put((step, snapshot, extra))
         self.saves += 1
         return self.directory / f"step_{step}"
 
@@ -399,6 +433,10 @@ class AsyncCheckpointManager(CheckpointManager):
     def latest_step(self) -> int | None:
         self.wait()  # an in-flight write IS the latest step once visible
         return super().latest_step()
+
+    def load_extra(self, step: int | None = None) -> dict | None:
+        self.wait()  # the sidecar lands with the write it rides
+        return super().load_extra(step)
 
     def stats(self) -> dict[str, Any]:
         return {"saves": self.saves, "wait_s": self.wait_s,

@@ -161,10 +161,15 @@ def run_with_recovery(config, max_restarts: int = 2,
 
     Requires ``config.checkpoint_dir`` (with ``checkpoint_every`` for
     intra-run durability).  After a failure the config is re-run with
-    ``resume=True`` so the harness restores the newest checkpoint
-    (utils/harness.py run()); up to ``max_restarts`` retries, then the last
-    exception propagates.  Divergence (TrainingDiverged) is NOT retried —
-    restarting into the same NaN is not recovery.
+    ``resume=True`` AND ``elastic_restore=True``: the restart goes through
+    the elastic restore (distributed_tensorflow_tpu/elastic/) rather than
+    a cold ``restore()`` — resharding-tolerant (the relaunch may land on a
+    different device count), continuing the exact batch sequence from the
+    checkpoint's data state (exactly-once over the dataset), with the
+    crash's cost reported as ``preemption_lost_s``/``resume_replay_steps``
+    in the resumed run's report.  Up to ``max_restarts`` retries, then the
+    last exception propagates.  Divergence (TrainingDiverged) is NOT
+    retried — restarting into the same NaN is not recovery.
 
     ``run_fn`` is injectable for tests; defaults to harness.run.
     """
@@ -191,4 +196,5 @@ def run_with_recovery(config, max_restarts: int = 2,
                 raise
             if on_restart is not None:
                 on_restart(attempt, e)
-            config = dataclasses.replace(config, resume=True)
+            config = dataclasses.replace(config, resume=True,
+                                         elastic_restore=True)

@@ -148,6 +148,28 @@ class ExperimentConfig:
                                            # on a background writer); False =
                                            # the synchronous blocking save
     resume: bool = False                   # restore latest checkpoint first
+    elastic_restore: bool = False          # mesh-shape-independent resume
+                                           # (elastic/reshard.py): restore
+                                           # the latest checkpoint onto
+                                           # THIS run's mesh whatever mesh
+                                           # wrote it (GSPMD family), with
+                                           # exactly-once data resume from
+                                           # the checkpoint's data state
+                                           # and preemption accounting
+                                           # (preemption_lost_s /
+                                           # resume_replay_steps in the
+                                           # run report)
+    max_steps_per_lease: int = 0           # >0: graceful lease drain
+                                           # (elastic/lease.py) — stop at
+                                           # the first chunk boundary at/
+                                           # after N steps this run, write
+                                           # the final checkpoint (data
+                                           # state included) and return a
+                                           # `preempted` result instead of
+                                           # training on.  Checkpointed
+                                           # runs also arm a SIGTERM
+                                           # preemption-notice handler
+                                           # that triggers the same drain
     metrics_path: str | None = None        # per-step metrics JSONL (async
                                            # crash-durable sink; rides the
                                            # chunked drain — no downshift)
@@ -1411,11 +1433,27 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     trainer = Trainer(None, engine=ex.engine, seed=config.seed)
 
     ckpt_mgr = None
+    resume_requested = config.resume or config.elastic_restore
     if config.resume and not config.checkpoint_dir:
         raise ValueError("--resume requires --checkpoint-dir")
+    if config.elastic_restore and not config.checkpoint_dir:
+        raise ValueError("--elastic-restore requires --checkpoint-dir")
     if config.checkpoint_every and not config.checkpoint_dir:
         raise ValueError("--checkpoint-every requires --checkpoint-dir "
                          "(no checkpoints would be written otherwise)")
+    if config.max_steps_per_lease < 0:
+        raise ValueError(f"--max-steps-per-lease must be >= 0, got "
+                         f"{config.max_steps_per_lease}")
+    if config.max_steps_per_lease and not config.checkpoint_dir:
+        raise ValueError("--max-steps-per-lease requires --checkpoint-dir "
+                         "(the lease drain's final checkpoint needs "
+                         "somewhere to go)")
+    # elastic-resume accounting, filled by the restore below and carried
+    # into the run report: seconds the preemption cost (save → resume
+    # wall-clock gap) and the data state the resumed fit continues from
+    resume_data_state = None
+    preemption_lost = None
+    restored_step = None
     if config.checkpoint_dir:
         from distributed_tensorflow_tpu.utils.checkpoint import (
             AsyncCheckpointManager, CheckpointManager)
@@ -1429,25 +1467,49 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         ckpt_mgr = (AsyncCheckpointManager(config.checkpoint_dir)
                     if config.async_checkpoint
                     else CheckpointManager(config.checkpoint_dir))
-        if config.resume:
+        if resume_requested:
             if ckpt_mgr.latest_step() is None:
-                print(f"warning: --resume set but no checkpoint found under "
+                flag = ("--elastic-restore" if config.elastic_restore
+                        else "--resume")
+                print(f"warning: {flag} set but no checkpoint found under "
                       f"{config.checkpoint_dir}; training from scratch")
             else:
                 rng = jax.random.key(config.seed)
                 template = ex.engine.init_state(
                     rng, train_ds.x[: max(1, ex.n)])
                 try:
-                    # policy-aware restore: a checkpoint written under the
-                    # SAME --precision restores directly; an f32-era
-                    # checkpoint restored into a master policy is adopted
-                    # (restored f32 params become the master, their
-                    # downcast the stored params — precision.py)
-                    from distributed_tensorflow_tpu.parallel import (
-                        precision as precisionlib)
+                    if config.elastic_restore:
+                        # mesh-shape-independent restore (elastic/
+                        # reshard.py): policy-aware per-leaf load, then
+                        # re-placement under THIS engine's spec map on
+                        # THIS mesh — the checkpoint may have been
+                        # written by a different device count or axis
+                        # layout.  The elastic sidecar comes back with
+                        # it: data state for the exactly-once resume
+                        # ({} when the checkpoint predates it → replay
+                        # accounting) and the save wall time the
+                        # preemption_lost_s figure is measured from.
+                        from distributed_tensorflow_tpu import (
+                            elastic as elasticlib)
 
-                    trainer.state = precisionlib.restore_into_policy(
-                        ckpt_mgr, template, ex.engine.precision)
+                        trainer.state, extra = elasticlib.elastic_restore(
+                            ckpt_mgr, ex.engine, template)
+                        resume_data_state = (
+                            (extra or {}).get("data_state") or {})
+                        preemption_lost = elasticlib.preemption_lost_s(
+                            extra)
+                    else:
+                        # policy-aware restore: a checkpoint written under
+                        # the SAME --precision restores directly; an
+                        # f32-era checkpoint restored into a master policy
+                        # is adopted (restored f32 params become the
+                        # master, their downcast the stored params —
+                        # precision.py)
+                        from distributed_tensorflow_tpu.parallel import (
+                            precision as precisionlib)
+
+                        trainer.state = precisionlib.restore_into_policy(
+                            ckpt_mgr, template, ex.engine.precision)
                 except Exception as e:
                     # the most common structure mismatch here is a --health
                     # toggle across the resume boundary: enable_health
@@ -1467,7 +1529,11 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                         f"policy automatically; other precision crossings "
                         f"need the original policy.  Original error: "
                         f"{type(e).__name__}: {e}") from e
-                sink.emit("resumed", step=ckpt_mgr.latest_step())
+                restored_step = ckpt_mgr.latest_step()
+                sink.emit("resumed", step=restored_step,
+                          elastic=config.elastic_restore,
+                          **({"preemption_lost_s": preemption_lost}
+                             if config.elastic_restore else {}))
 
     metrics_logger = None
     if config.metrics_path:
@@ -1485,6 +1551,23 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
 
     tracer = Tracer(path=config.trace_path,
                     process_index=jax.process_index())
+
+    # elastic lease + straggler detection (distributed_tensorflow_tpu/
+    # elastic/): every checkpointed run arms the graceful SIGTERM drain —
+    # a preemption notice finishes the in-flight chunk, writes a final
+    # checkpoint with its data state and returns a structured `preempted`
+    # result instead of a corpse; --max-steps-per-lease adds the step
+    # budget.  The straggler detector rides the step times the Trainer
+    # already measures (zero extra syncs) and emits structured
+    # `straggler` trace events on outliers.
+    from distributed_tensorflow_tpu.elastic import (
+        LeaseManager, StragglerDetector)
+
+    lease = None
+    if config.checkpoint_dir:
+        lease = LeaseManager(
+            max_steps_per_lease=config.max_steps_per_lease).install()
+    straggler = StragglerDetector(tracer=tracer)
 
     # one-time exposed-vs-hidden collective measurement (the overlap
     # opt-in pays two extra step compiles for the number BASELINE.md
@@ -1533,15 +1616,40 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                                   on_anomaly=config.on_anomaly,
                                   steps_per_call=config.steps_per_call,
                                   prefetch=config.prefetch,
-                                  tracer=tracer)
+                                  tracer=tracer,
+                                  should_stop=(lease.should_stop
+                                               if lease is not None
+                                               else None),
+                                  data_state=resume_data_state,
+                                  straggler_detector=straggler)
         finally:
             if watchdog is not None:
                 watchdog.close()
+            if lease is not None:
+                # restore the previous SIGTERM disposition: a later run in
+                # this process must not drain into THIS run's lease
+                lease.uninstall()
         if config.grad_bucket_mb:
             # ride the fit result into the run report (None when the
             # probe was unsupported/failed — "measured 0" stays
             # distinguishable from "not measured")
             fit["collective_overlap"] = overlap_probe
+        # preemption accounting (elastic/): the restore-side numbers ride
+        # the fit result into the run report next to the fit-side ones
+        # (preempted / resume_replay_steps / stragglers), and a drained
+        # lease emits the structured `preempted` event an external
+        # supervisor reads instead of finding a corpse
+        if config.elastic_restore:
+            fit["preemption_lost_s"] = preemption_lost
+            fit["restored_step"] = restored_step
+        if lease is not None:
+            fit["lease"] = lease.report()
+        if fit.get("preempted"):
+            # the supervisor-protocol drain notice (utils/supervisor.py
+            # ResultSink.preempted): an external harness sees a planned
+            # ['preempted', reason, step] instead of a dead socket
+            sink.preempted(fit["preempted"],
+                           fit.get("start_step", 0) + fit["steps"])
         sink.done(fit["elapsed"])
         with tracer.span("eval", final=True):
             ev = trainer.evaluate(test_ds, batch_size=config.eval_batch)
@@ -1574,6 +1682,10 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
             "epochs": config.epochs,
             "precision": fit.get("precision", config.precision),
             "steps": fit["steps"],
+            # graceful-drain outcome: the lease reason when this run was
+            # preempted (SIGTERM notice / --max-steps-per-lease), None on
+            # a normal finish — relaunch with --elastic-restore
+            "preempted": fit.get("preempted"),
             # resolved steady-state drain shape (auto may downshift to 1)
             "steps_per_call": fit.get("steps_per_call"),
             "prefetch_depth": fit.get("prefetch_depth"),

@@ -67,6 +67,21 @@ class ResultSink:
         if self._sock:
             wire.send_msg(self._sock, ["results", accuracy])
 
+    def preempted(self, reason: str, step: int,
+                  **extra: Any) -> None:
+        """Graceful-drain notice (elastic/lease.py): the run ended its
+        lease — SIGTERM preemption notice or ``--max-steps-per-lease``
+        budget — after writing a final checkpoint, and a relaunch with
+        ``--elastic-restore`` continues it from ``step``.  Extends the
+        reference's event triple with a fourth shape,
+        ``['preempted', reason, step]``, so an external supervisor
+        distinguishes a planned drain (relaunch me) from a corpse
+        (investigate me); JSONL consumers get the same fields as a
+        structured ``preempted`` event."""
+        self.emit("preempted", reason=reason, step=step, **extra)
+        if self._sock:
+            wire.send_msg(self._sock, ["preempted", reason, step])
+
     def close(self) -> None:
         if self._sock:
             self._sock.close()

@@ -82,7 +82,7 @@ class SlowWriter(AsyncCheckpointManager):
         self.writes = 0
         super().__init__(*args, **kw)
 
-    def _write(self, step, host_state):
+    def _write(self, step, host_state, extra=None):
         self.writes += 1
         time.sleep(self.delay)
         if self.crash_at is not None and self.writes == self.crash_at:
@@ -93,7 +93,7 @@ class SlowWriter(AsyncCheckpointManager):
         if self.fake:
             (self.directory / f"step_{step}").mkdir(exist_ok=True)
             return
-        super()._write(step, host_state)
+        super()._write(step, host_state, extra)
 
 
 class SlowSyncWriter(CheckpointManager):
@@ -106,12 +106,12 @@ class SlowSyncWriter(CheckpointManager):
         self.fake = fake
         super().__init__(*args, **kw)
 
-    def _write(self, step, host_state):
+    def _write(self, step, host_state, extra=None):
         time.sleep(self.delay)
         if self.fake:
             (self.directory / f"step_{step}").mkdir(exist_ok=True)
             return
-        super()._write(step, host_state)
+        super()._write(step, host_state, extra)
 
 
 class _SlowBatchDataset:
@@ -238,7 +238,7 @@ def test_sync_write_is_atomic_too(tmp_path):
     _eng, _ds, state = _trained_state()
 
     class CrashingSync(CheckpointManager):
-        def _write(self, step, host_state):
+        def _write(self, step, host_state, extra=None):
             tmp = self.directory / f"tmp_step_{step}"
             tmp.mkdir(exist_ok=True)
             (tmp / "partial.bin").write_text("torn")

@@ -1,0 +1,809 @@
+"""Elastic preemption-tolerant training (distributed_tensorflow_tpu/elastic/).
+
+Covers the four pillars ISSUE 9 names, at every layer that container jax
+can run:
+
+* **Exactly-once data resume**: ``DataState`` round-trips, ``start_batch``
+  stream-continuation parity on every loader path, the prefetch-drain
+  no-drop/no-replay proof (``consumer_state``), and a killed-and-resumed
+  Trainer whose metric stream is BITWISE the uninterrupted run's — at
+  k=1 and k=8 (mirroring tests/test_steady_state.py).
+* **Resharding restore**: an FSDP checkpoint restored onto a different
+  device count AND a different mesh-axis layout, the (same/different
+  mesh × same/different precision policy) cross-product, legacy
+  (sidecar-less) checkpoints, and the named error on unbridgeable
+  layouts.
+* **Graceful lease drain**: LeaseManager units (budget, SIGTERM flag,
+  install/uninstall), the Trainer ``should_stop`` drain at k=1 and k=8
+  (final checkpoint carries the data state), and the harness/CLI e2e —
+  ``--max-steps-per-lease`` drain, ``--elastic-restore`` resume onto a
+  different ``-n``, the ``preempted``/``preemption_lost_s`` report
+  sections, and the supervisor-protocol ``['preempted', reason, step]``
+  message.
+* **Straggler detection + accounting**: outlier flagging against the
+  running median, median adaptation, the structured ``straggler`` trace
+  event, and `analyze diff` gating of
+  ``preemption_lost_s``/``resume_replay_steps``/``straggler_events``.
+
+The GSPMD tests run on FSDPEngine (pure jit — every container); the
+Trainer-level tests ride test_steady_state's JitEngine.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu import elastic
+from distributed_tensorflow_tpu.data.device_prefetch import DevicePrefetch
+from distributed_tensorflow_tpu.data.loaders import (
+    Dataset, load_dataset, synthetic_classification)
+from distributed_tensorflow_tpu.data.pipeline import iter_batches
+from distributed_tensorflow_tpu.elastic import (
+    DataState, ElasticRestoreError, LeaseManager, ResumableBatches,
+    StragglerDetector, consumer_state, elastic_restore, preemption_lost_s)
+from distributed_tensorflow_tpu.engines.allreduce import Trainer
+from distributed_tensorflow_tpu.utils.checkpoint import CheckpointManager
+from distributed_tensorflow_tpu.utils.metrics import MetricsLogger
+
+from test_steady_state import JitEngine, _tiny_ds  # noqa: E402
+
+
+# ------------------------------------------------------------- DataState
+
+def test_data_state_json_roundtrip():
+    st = DataState(epoch=2, batch_index=7, seed=3, batch_size=32,
+                   dataset_len=1024, dataset="mnist")
+    back = DataState.from_json(st.to_json())
+    assert back == st
+    assert back.version == elastic.DATA_STATE_VERSION
+
+
+@pytest.mark.parametrize("garbage", [
+    None, [], "x", 42, {}, {"epoch": 1}, {"epoch": "a", "batch_index": 0,
+                                          "seed": 0, "batch_size": 1,
+                                          "dataset_len": 1},
+])
+def test_data_state_tolerant_decode(garbage):
+    """A garbled/foreign sidecar must decode to None (replay accounting),
+    never raise — old checkpoints stay restorable."""
+    assert DataState.from_json(garbage) is None
+
+
+def test_data_state_matching_guards_the_stream_identity():
+    st = DataState(epoch=0, batch_index=3, seed=1, batch_size=16,
+                   dataset_len=256, dataset="tiny")
+    assert st.matches(seed=1, batch_size=16, dataset_len=256)
+    assert st.matches(seed=1, batch_size=16, dataset_len=256,
+                      dataset="tiny")
+    # any identity-field mismatch describes a DIFFERENT batch sequence —
+    # including the dataset NAME: two datasets can coincide in
+    # seed/batch/length and still be different streams
+    assert not st.matches(seed=2, batch_size=16, dataset_len=256)
+    assert not st.matches(seed=1, batch_size=32, dataset_len=256)
+    assert not st.matches(seed=1, batch_size=16, dataset_len=512)
+    assert not st.matches(seed=1, batch_size=16, dataset_len=256,
+                          dataset="other")
+
+
+# ---------------------------------------------- start_batch stream parity
+
+def test_iter_batches_start_batch_continues_exact_sequence():
+    x, y = synthetic_classification((4,), 3, 100, seed=7)
+    full = list(iter_batches(x, y, 16, shuffle=True, seed=5, epoch=2,
+                             drop_remainder=True))
+    resumed = list(iter_batches(x, y, 16, shuffle=True, seed=5, epoch=2,
+                                drop_remainder=True, start_batch=3))
+    assert len(resumed) == len(full) - 3
+    for (ax, ay, am), (bx, by, bm) in zip(full[3:], resumed):
+        np.testing.assert_array_equal(ax, bx)
+        np.testing.assert_array_equal(ay, by)
+        np.testing.assert_array_equal(am, bm)
+
+
+def test_iter_batches_start_batch_validation():
+    x, y = synthetic_classification((4,), 3, 32, seed=0)
+    with pytest.raises(ValueError, match="start_batch"):
+        list(iter_batches(x, y, 8, start_batch=-1))
+    # skipping the whole epoch yields an empty stream, not an error
+    assert list(iter_batches(x, y, 8, drop_remainder=True,
+                             start_batch=99)) == []
+
+
+@pytest.mark.parametrize("name", ["synthetic", "lm_synth", "mnist"])
+def test_dataset_start_batch_parity_per_loader(name):
+    """Satellite: the ``start_batch`` resume contract holds on every
+    loader path — classification (C++-pipeline-eligible), LM ((B, L)
+    labels force the Python path) and the mnist loader (real archive or
+    its synthetic fallback, whichever this container has)."""
+    ds = load_dataset(name, split="train")
+    full = list(ds.batches(32, shuffle=True, seed=1, epoch=0,
+                           drop_remainder=True, native=False))
+    resumed = list(ds.batches(32, shuffle=True, seed=1, epoch=0,
+                              drop_remainder=True, start_batch=2))
+    assert len(resumed) == len(full) - 2
+    for (ax, ay, _), (bx, by, _) in zip(full[2:], resumed):
+        np.testing.assert_array_equal(ax, bx)
+        np.testing.assert_array_equal(ay, by)
+
+
+def test_dataset_start_batch_rejects_native_pipeline():
+    ds = load_dataset("synthetic", split="train")
+    with pytest.raises(RuntimeError, match="native"):
+        ds.batches(32, start_batch=1, native=True)
+
+
+# ------------------------------------- ResumableBatches + prefetch drain
+
+def test_resumable_batches_state_restore_roundtrip():
+    ds = _tiny_ds(192)
+    rb = ResumableBatches(ds, 16, seed=4, epoch=1)
+    consumed = [next(rb) for _ in range(5)]
+    st = rb.state()
+    assert (st.epoch, st.batch_index) == (1, 5)
+    rest = list(ResumableBatches.restore(ds, st))
+    uninterrupted = list(ResumableBatches(ds, 16, seed=4, epoch=1))
+    assert len(consumed) + len(rest) == len(uninterrupted)
+    for (ax, ay, _), (bx, by, _) in zip(uninterrupted[5:], rest):
+        np.testing.assert_array_equal(ax, bx)
+        np.testing.assert_array_equal(ay, by)
+    rb.close()
+
+
+def test_resumable_batches_restore_validates_dataset():
+    ds = _tiny_ds(192)
+    st = dataclasses.replace(ResumableBatches(ds, 16).state(),
+                             dataset_len=7)
+    with pytest.raises(ValueError, match="dataset"):
+        ResumableBatches.restore(ds, st)
+    # a name mismatch at coinciding length is still a different stream
+    st = dataclasses.replace(ResumableBatches(ds, 16).state(),
+                             dataset="other")
+    with pytest.raises(ValueError, match="other"):
+        ResumableBatches.restore(ds, st)
+
+
+def test_prefetch_drain_no_drop_no_replay():
+    """THE exactly-once discounting proof: with the prefetcher reading
+    ``depth`` batches ahead, checkpointing the CONSUMER position
+    (``consumer_state``) and resuming yields every staged-but-untrained
+    batch exactly once and no trained batch twice."""
+    ds = _tiny_ds(192)  # 12 batches of 16
+    rb = ResumableBatches(ds, 16, seed=0, epoch=0)
+    pf = DevicePrefetch(rb, lambda b: b, depth=3)
+    trained = [pf.__next__() for _ in range(4)]
+    # producer ran ahead: 4 consumed + 3 staged
+    assert pf.consumed == 4
+    assert rb.state().batch_index == 7
+    st = consumer_state(rb, pf)
+    assert st.batch_index == 4  # read-ahead discounted
+    pf.close()  # the "kill": staged batches are dropped with the process
+    resumed = list(ResumableBatches.restore(ds, st))
+    full = list(ResumableBatches(ds, 16, seed=0, epoch=0))
+    # no replay: resumed stream starts exactly after the trained batches
+    # no drop: the 3 staged-but-untrained batches lead the resumed stream
+    assert len(trained) + len(resumed) == len(full) == 12
+    for (ax, ay, _), (bx, by, _) in zip(full[4:], resumed):
+        np.testing.assert_array_equal(ax, bx)
+        np.testing.assert_array_equal(ay, by)
+
+
+def test_prefetch_consumed_gauge_in_stats():
+    pf = DevicePrefetch(iter([(np.zeros(2), np.zeros(2), np.ones(2))] * 5),
+                        lambda b: b, depth=2)
+    next(pf)
+    next(pf)
+    assert pf.stats()["consumed"] == 2
+
+
+# ------------------------------------------------------- LeaseManager
+
+def test_lease_step_budget():
+    lm = LeaseManager(max_steps_per_lease=5)
+    assert lm.should_stop(4) is None
+    assert lm.should_stop(5) == "max_steps_per_lease:5"
+    assert lm.should_stop(9) == "max_steps_per_lease:5"
+    assert LeaseManager(0).should_stop(10 ** 9) is None  # 0 disables
+    with pytest.raises(ValueError, match="max_steps_per_lease"):
+        LeaseManager(-1)
+
+
+def test_lease_sigterm_sets_flag_and_drains():
+    lm = LeaseManager().install()
+    try:
+        assert lm.installed
+        assert lm.should_stop(1) is None
+        os.kill(os.getpid(), signal.SIGTERM)  # the preemption notice
+        # the handler ONLY set a flag; the drain decision happens here
+        assert lm.should_stop(1) == "signal:SIGTERM"
+        rep = lm.report()
+        assert rep["signal_handler_installed"] is True
+        assert rep["preempt_signal"] == "SIGTERM"
+    finally:
+        lm.uninstall()
+    assert not lm.installed
+    # sticky record: a report taken after teardown still says it was armed
+    assert lm.report()["signal_handler_installed"] is True
+
+
+def test_lease_uninstall_restores_previous_handler():
+    seen = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+    try:
+        with LeaseManager() as lm:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert lm.preempt_signal == signal.SIGTERM
+            assert not seen  # the lease owned the signal
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert seen == [signal.SIGTERM]  # previous disposition is back
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_lease_install_off_main_thread_degrades_to_budget():
+    box = {}
+
+    def t():
+        box["lm"] = LeaseManager(max_steps_per_lease=2).install()
+
+    th = threading.Thread(target=t)
+    th.start()
+    th.join()
+    lm = box["lm"]
+    assert not lm.installed  # signal.signal is main-thread-only
+    assert lm.should_stop(2) == "max_steps_per_lease:2"  # budget survives
+
+
+# ------------------------------------------------------ StragglerDetector
+
+class _FakeTracer:
+    def __init__(self):
+        self.events = []
+
+    def event(self, name, **attrs):
+        self.events.append({"name": name, **attrs})
+
+
+def test_straggler_flags_outlier_and_emits_event():
+    tr = _FakeTracer()
+    sd = StragglerDetector(tracer=tr, factor=3.0, min_samples=5)
+    for i in range(6):
+        assert not sd.observe(i, 0.1)
+    assert sd.observe(6, 0.5)  # 5× the median
+    assert sd.events == 1 and sd.last_straggler_step == 6
+    assert sd.max_ratio == pytest.approx(5.0)
+    (ev,) = tr.events
+    assert ev["name"] == "straggler" and ev["step"] == 6
+    assert ev["ratio"] == pytest.approx(5.0)
+    rep = sd.report()
+    assert rep["events"] == 1 and rep["observed"] == 7
+
+
+def test_straggler_needs_min_samples_and_adapts_to_new_pace():
+    sd = StragglerDetector(factor=3.0, min_samples=5, window=8)
+    assert not sd.observe(0, 10.0)  # huge, but no baseline yet
+    for i in range(8):
+        sd.observe(i, 0.1)
+    assert sd.observe(99, 1.0)  # outlier vs the 0.1 median
+    # a SUSTAINED 1.0 pace becomes the new normal: flagging stops once
+    # the bounded window's median catches up
+    flags = [sd.observe(100 + i, 1.0) for i in range(12)]
+    assert not any(flags[8:])
+    assert sd.report()["max_ratio"] >= 3.0
+
+
+def test_straggler_validation():
+    with pytest.raises(ValueError, match="factor"):
+        StragglerDetector(factor=1.0)
+    with pytest.raises(ValueError, match="min_samples"):
+        StragglerDetector(min_samples=1)
+
+
+def test_straggler_quiet_report_has_no_ratio():
+    sd = StragglerDetector()
+    sd.observe(1, 0.1)
+    rep = sd.report()
+    assert rep["events"] == 0 and rep["max_ratio"] is None
+
+
+# ------------------------------------- Trainer drain + exactly-once resume
+
+def _fit(trainer, ds, k, **kw):
+    ml = MetricsLogger(None, log_every=1)
+    r = trainer.fit(ds, epochs=2, batch_size=16, log_every=0,
+                    steps_per_call=k, metrics_logger=ml, **kw)
+    return r, [(m["step"], m["loss"], m["accuracy"]) for m in ml.records]
+
+
+@pytest.mark.parametrize("k", [1, 8])
+def test_trainer_should_stop_drains_at_boundary(k, tmp_path):
+    """The graceful drain at both drain shapes: fit stops at the first
+    chunk boundary where should_stop fires, reports the reason, and the
+    final checkpoint carries the boundary's data state."""
+    mgr = CheckpointManager(tmp_path / "c")
+    tr = Trainer(None, engine=JitEngine(), seed=0)
+    lm = LeaseManager(max_steps_per_lease=5)
+    r, traj = _fit(tr, _tiny_ds(), k, checkpoint_manager=mgr,
+                   should_stop=lm.should_stop)
+    assert r["preempted"] == "max_steps_per_lease:5"
+    expected = 5 if k == 1 else 8  # first boundary at/after the budget
+    assert r["steps"] == expected
+    assert mgr.latest_step() == expected
+    extra = mgr.load_extra()
+    st = DataState.from_json(extra["data_state"])
+    assert st is not None and st.batch_index == expected
+    assert extra["step"] == expected and extra["wall_time"] > 0
+
+
+def test_trainer_sigterm_mid_fit_drains_with_checkpoint(tmp_path):
+    """A SIGTERM delivered DURING the fit (the scheduler's preemption
+    notice) finishes the in-flight chunk and exits with the structured
+    reason — no exception, no corpse."""
+    mgr = CheckpointManager(tmp_path / "c")
+    tr = Trainer(None, engine=JitEngine(), seed=0)
+    with LeaseManager() as lm:
+        fired = {}
+
+        def stop_hook(steps_done):
+            # deliver the signal from inside the loop (deterministic:
+            # mid-fit, AFTER this boundary's decision) — the flag is
+            # read at the NEXT boundary, exactly like an async delivery
+            reason = lm.should_stop(steps_done)
+            if steps_done == 3 and not fired:
+                fired["at"] = steps_done
+                os.kill(os.getpid(), signal.SIGTERM)
+            return reason
+
+        r, _ = _fit(tr, _tiny_ds(), 1, checkpoint_manager=mgr,
+                    should_stop=stop_hook)
+    assert r["preempted"] == "signal:SIGTERM"
+    assert r["steps"] == 4  # the boundary after the notice
+    assert mgr.latest_step() == 4
+
+
+@pytest.mark.parametrize("k", [1, 8])
+def test_kill_and_resume_bitwise_same_mesh(k, tmp_path):
+    """THE acceptance property (same mesh): a run checkpointed at step 6,
+    killed, and resumed with the checkpoint's data state produces the
+    BITWISE identical metric stream and final params as the uninterrupted
+    run — at k=1 AND k=8 (the resume-parity mirror of
+    tests/test_steady_state.py)."""
+    tru = Trainer(None, engine=JitEngine(), seed=0)
+    ru, traj_u = _fit(tru, _tiny_ds(), k, max_steps=13)
+    assert ru["steps"] == 13
+
+    mgr = CheckpointManager(tmp_path / "c")
+    tr1 = Trainer(None, engine=JitEngine(), seed=0)
+    r1, traj1 = _fit(tr1, _tiny_ds(), k, checkpoint_manager=mgr,
+                     checkpoint_every=6, max_steps=6)
+    # "kill": fresh trainer restores state + sidecar, continues the stream
+    tr2 = Trainer(None, engine=JitEngine(), seed=0)
+    template = tr2.engine.init_state(jax.random.key(0), _tiny_ds().x[:1])
+    tr2.state, extra = elastic_restore(mgr, tr2.engine, template)
+    r2, traj2 = _fit(tr2, _tiny_ds(), k, max_steps=7,
+                     data_state=extra["data_state"])
+    assert r2["resume_replay_steps"] == 0
+    assert r2["start_step"] == 6
+    assert traj1 + traj2 == traj_u  # bitwise, steps 1..13
+    for a, b in zip(jax.tree.leaves(jax.device_get(tru.state.params)),
+                    jax.tree.leaves(jax.device_get(tr2.state.params))):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_resume_without_data_state_reports_replay(tmp_path):
+    """A pre-elastic checkpoint (no sidecar) still restores — the stream
+    restarts from epoch 0 and the unrecoverable positions surface as
+    resume_replay_steps, with a warning."""
+    mgr = CheckpointManager(tmp_path / "c")
+    tr1 = Trainer(None, engine=JitEngine(), seed=0)
+    _fit(tr1, _tiny_ds(), 4, max_steps=6)
+    mgr.save(tr1.state, step=6)  # direct save: no elastic sidecar
+    assert mgr.load_extra() is None
+
+    tr2 = Trainer(None, engine=JitEngine(), seed=0)
+    template = tr2.engine.init_state(jax.random.key(0), _tiny_ds().x[:1])
+    tr2.state, extra = elastic_restore(mgr, tr2.engine, template)
+    assert extra is None
+    logs = []
+    r2 = tr2.fit(_tiny_ds(), epochs=1, batch_size=16, log_every=0,
+                 steps_per_call=4, max_steps=4, data_state={},
+                 log_fn=logs.append)
+    assert r2["resume_replay_steps"] == 6
+    assert any("resume_replay_steps=6" in line for line in logs)
+
+
+def test_mid_epoch_and_cross_epoch_resume_positions(tmp_path):
+    """The data state crosses epoch boundaries correctly: a checkpoint at
+    a step past epoch 0's end records (epoch 1, offset), and the resumed
+    fit continues there — only the FIRST resumed epoch starts offset."""
+    ds = _tiny_ds(96)  # 6 batches of 16 per epoch
+    mgr = CheckpointManager(tmp_path / "c")
+    tr = Trainer(None, engine=JitEngine(), seed=0)
+    r, _ = _fit(tr, ds, 4, checkpoint_manager=mgr, checkpoint_every=8,
+                max_steps=8)
+    st = DataState.from_json(mgr.load_extra()["data_state"])
+    assert (st.epoch, st.batch_index) == (1, 2)  # 8 = 6 + 2
+
+
+# ------------------------------------------------- resharding (FSDP/GSPMD)
+
+def _fsdp_engine(n_devices=None, mesh=None, precision="f32", dtype=None):
+    from distributed_tensorflow_tpu.engines.fsdp import FSDPEngine
+    from distributed_tensorflow_tpu.models import create_model
+    from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+    if mesh is None:
+        mesh = meshlib.create_mesh(n_devices)
+    kw = {"dtype": dtype} if dtype else {}
+    return FSDPEngine(create_model("mlp", num_classes=4, hidden=32, **kw),
+                      mesh=mesh, learning_rate=5e-3, precision=precision)
+
+
+def _fsdp_ds():
+    x, y = synthetic_classification((8,), 4, 256, seed=3)
+    return Dataset(x=x, y=y, num_classes=4, name="tiny", synthetic=True)
+
+
+def _train_and_save(tmp_path, *, n_devices=8, precision="f32", dtype=None,
+                    steps=6):
+    ds = _fsdp_ds()
+    eng = _fsdp_engine(n_devices, precision=precision, dtype=dtype)
+    tr = Trainer(None, engine=eng, seed=0)
+    mgr = CheckpointManager(tmp_path / "ck")
+    tr.fit(ds, epochs=2, batch_size=32, log_every=0, steps_per_call=4,
+           checkpoint_manager=mgr, checkpoint_every=steps, max_steps=steps)
+    return mgr, tr, ds
+
+
+def _assert_tree_equal(a, b, exact=True):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x, y = np.asarray(jax.device_get(x)), np.asarray(jax.device_get(y))
+        if exact:
+            np.testing.assert_array_equal(x, y)
+        else:
+            np.testing.assert_allclose(x, y, rtol=1e-6)
+
+
+@pytest.mark.parametrize("target", ["same8", "count4", "count2",
+                                    "layout4x2"])
+def test_reshard_restore_across_mesh_shapes(tmp_path, target):
+    """Resharding restore: a checkpoint written on an 8-device ('data',)
+    fsdp mesh restores bitwise onto the SAME mesh, onto smaller device
+    counts, and onto a different axis LAYOUT (('data','model') 4×2) —
+    every leaf re-placed under the target engine's spec map."""
+    from distributed_tensorflow_tpu.parallel import mesh as meshlib
+
+    mgr, tr_src, ds = _train_and_save(tmp_path)
+    if target == "layout4x2":
+        mesh = meshlib.create_mesh(8, shape=(4, 2),
+                                   axis_names=("data", "model"))
+        eng = _fsdp_engine(mesh=mesh)
+    else:
+        eng = _fsdp_engine({"same8": 8, "count4": 4, "count2": 2}[target])
+    template = eng.init_state(jax.random.key(0), ds.x[: eng.n_devices])
+    state, extra = elastic_restore(mgr, eng, template)
+    assert int(np.asarray(jax.device_get(state.step))) == 6
+    _assert_tree_equal(tr_src.state.params, state.params)
+    _assert_tree_equal(tr_src.state.opt_state, state.opt_state)
+    # the sidecar rides along, whatever the target mesh
+    assert DataState.from_json(extra["data_state"]).batch_index == 6
+    # every mesh-placed leaf landed under the TARGET engine's spec map
+    from jax.sharding import NamedSharding
+
+    specs = eng.state_partition_specs(template)
+    checked = 0
+    for leaf, spec in zip(jax.tree.leaves(state), jax.tree.leaves(specs)):
+        if isinstance(leaf, jax.Array) and isinstance(
+                getattr(leaf, "sharding", None), NamedSharding):
+            assert dict(leaf.sharding.mesh.shape) == dict(eng.mesh.shape)
+            assert leaf.sharding.spec == spec
+            checked += 1
+    assert checked > 0
+
+
+def test_reshard_restore_continues_training(tmp_path):
+    """The restored-on-a-smaller-mesh state is a WORKING TrainState: a
+    further fit with the sidecar's data state continues the loss
+    trajectory of the uninterrupted source run within tolerance (the
+    cross-mesh acceptance bound; same-mesh bitwise is proved above)."""
+    ds = _fsdp_ds()
+    # uninterrupted 10-step reference on the source mesh
+    tru = Trainer(None, engine=_fsdp_engine(8), seed=0)
+    mlu = MetricsLogger(None, log_every=1)
+    tru.fit(ds, epochs=2, batch_size=32, log_every=0, steps_per_call=4,
+            metrics_logger=mlu, max_steps=10)
+    traj_u = [(m["step"], m["loss"]) for m in mlu.records]
+
+    mgr, _, _ = _train_and_save(tmp_path, steps=6)
+    eng4 = _fsdp_engine(4)
+    template = eng4.init_state(jax.random.key(0), ds.x[:4])
+    tr = Trainer(None, engine=eng4, seed=0)
+    tr.state, extra = elastic_restore(mgr, eng4, template)
+    ml = MetricsLogger(None, log_every=1)
+    r = tr.fit(ds, epochs=2, batch_size=32, log_every=0, steps_per_call=4,
+               metrics_logger=ml, data_state=extra["data_state"],
+               max_steps=4)
+    assert r["resume_replay_steps"] == 0
+    traj_r = [(m["step"], m["loss"]) for m in ml.records]
+    assert [s for s, _ in traj_r] == [s for s, _ in traj_u[6:]]
+    np.testing.assert_allclose([l for _, l in traj_r],
+                               [l for _, l in traj_u[6:]], rtol=1e-5)
+
+
+@pytest.mark.parametrize("n_target", [8, 4])
+def test_reshard_f32_checkpoint_into_master_policy(tmp_path, n_target):
+    """Satellite bug-sweep cross-product, policy-crossing half: an
+    f32-era checkpoint restores into a bf16-f32master run on the same
+    AND a different mesh — the restored f32 params become the master,
+    their downcast the stored params."""
+    import jax.numpy as jnp
+
+    mgr, tr_src, ds = _train_and_save(tmp_path, precision="f32")
+    eng = _fsdp_engine(n_target, precision="bf16-f32master",
+                       dtype="bfloat16")
+    template = eng.init_state(jax.random.key(0), ds.x[:n_target])
+    state, _extra = elastic_restore(mgr, eng, template)
+    from distributed_tensorflow_tpu.parallel import precision as plib
+
+    masters = [n for n in jax.tree.leaves(
+        state.opt_state,
+        is_leaf=lambda x: isinstance(x, plib.MasterWeightsState))
+        if isinstance(n, plib.MasterWeightsState)]
+    assert masters, "no master node in the adopted optimizer state"
+    _assert_tree_equal(tr_src.state.params, masters[0].master)
+    for p, m in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(masters[0].master)):
+        assert p.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(np.asarray(jax.device_get(p)),
+                                      np.asarray(jax.device_get(m)).astype(
+                                          jnp.bfloat16))
+
+
+@pytest.mark.parametrize("n_target", [8, 4])
+def test_reshard_same_policy_roundtrip_bf16_master(tmp_path, n_target):
+    """Cross-product, same-policy half: a bf16-f32master checkpoint
+    restores bitwise into a bf16-f32master run on the same and a
+    different mesh (master copies reshard with their params)."""
+    mgr, tr_src, ds = _train_and_save(tmp_path, precision="bf16-f32master",
+                                      dtype="bfloat16")
+    eng = _fsdp_engine(n_target, precision="bf16-f32master",
+                       dtype="bfloat16")
+    template = eng.init_state(jax.random.key(0), ds.x[:n_target])
+    state, _ = elastic_restore(mgr, eng, template)
+    _assert_tree_equal(tr_src.state.params, state.params)
+    _assert_tree_equal(tr_src.state.opt_state, state.opt_state)
+
+
+def test_reshard_unbridgeable_layout_raises_named_error(tmp_path):
+    """A structure the target cannot express (here: a master-policy
+    checkpoint into an f32 run) raises ElasticRestoreError naming the
+    GSPMD coverage and the precision rule, not a raw tree mismatch."""
+    mgr, _, ds = _train_and_save(tmp_path, precision="bf16-f32master",
+                                 dtype="bfloat16")
+    eng = _fsdp_engine(4, precision="f32")
+    template = eng.init_state(jax.random.key(0), ds.x[:4])
+    with pytest.raises(ElasticRestoreError, match="GSPMD"):
+        elastic_restore(mgr, eng, template)
+
+
+def test_preemption_lost_s_accounting():
+    assert preemption_lost_s(None) is None
+    assert preemption_lost_s({}) is None
+    assert preemption_lost_s({"wall_time": True}) is None  # bool guard
+    lost = preemption_lost_s({"wall_time": 100.0}, now=130.0)
+    assert lost == pytest.approx(30.0)
+    # clock skew must not report negative lost time
+    assert preemption_lost_s({"wall_time": 100.0}, now=90.0) == 0.0
+
+
+def test_elastic_restore_pins_requested_step(tmp_path):
+    mgr, _, ds = _train_and_save(tmp_path, steps=4)
+    eng = _fsdp_engine(4)
+    template = eng.init_state(jax.random.key(0), ds.x[:4])
+    state, extra = elastic_restore(mgr, eng, template, step=4)
+    assert int(np.asarray(jax.device_get(state.step))) == 4
+    assert extra["step"] == 4
+
+
+# -------------------------------------------------- harness / CLI / e2e
+
+def _tiny_dataset_fn(batch_size, type="train"):  # noqa: A002 — harness API
+    n = 256 if type == "train" else 64
+    x, y = synthetic_classification((8,), 4, n, seed=3)
+    return Dataset(x=x, y=y, num_classes=4, name="tiny", synthetic=True)
+
+
+def _econfig(tmp_path, **kw):
+    from distributed_tensorflow_tpu.utils.harness import ExperimentConfig
+
+    base = dict(engine="fsdp", model="mlp", dataset="synthetic",
+                dataset_fn=_tiny_dataset_fn, n_devices=4, batch_size=8,
+                epochs=2, log_every=0, steps_per_call=4, eval_batch=64,
+                checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=4)
+    base.update(kw)
+    return ExperimentConfig(**base)
+
+
+def test_harness_lease_drain_and_elastic_resume_cross_count(tmp_path):
+    """Kill-and-resume acceptance at the harness layer: a run drained by
+    --max-steps-per-lease, then resumed with --elastic-restore onto a
+    DIFFERENT device count (same global batch), continues the exact
+    stream — `preempted`, `preemption_lost_s`, `resume_replay_steps` all
+    in the report, and `analyze diff` self-compares the new keys."""
+    from distributed_tensorflow_tpu.observability.analyze import (
+        diff_reports, load_report)
+    from distributed_tensorflow_tpu.utils.harness import run
+
+    s1 = run(_econfig(tmp_path, max_steps_per_lease=6))
+    assert s1["preempted"] == "max_steps_per_lease:6"
+    assert s1["steps"] == 8  # first chunk boundary at/after the budget
+    rep1 = s1["run_report"]
+    assert rep1["preempted"] == s1["preempted"]
+    assert rep1["lease"]["max_steps_per_lease"] == 6
+    assert rep1["stragglers"]["observed"] > 0
+
+    # resume on HALF the devices, same global batch (8×4 == 16×2)
+    s2 = run(_econfig(tmp_path, n_devices=2, batch_size=16,
+                      elastic_restore=True, max_steps_per_lease=4))
+    rep2 = s2["run_report"]
+    assert rep2["restored_step"] == 8
+    assert rep2["resume_replay_steps"] == 0  # exact stream continuation
+    assert rep2["preemption_lost_s"] is not None
+    assert rep2["preemption_lost_s"] >= 0.0
+
+    out = tmp_path / "summary.json"
+    out.write_text(json.dumps(s2))
+    d = diff_reports(load_report(out), load_report(out))
+    assert not d["regressions"]
+    compared = {r["metric"] for r in d["unchanged"]}
+    assert {"preemption_lost_s", "resume_replay_steps",
+            "straggler_events"} <= compared
+
+
+def test_harness_elastic_restore_requires_checkpoint_dir(tmp_path):
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    with pytest.raises(ValueError, match="elastic-restore"):
+        run(ExperimentConfig(elastic_restore=True))
+    with pytest.raises(ValueError, match="max-steps-per-lease"):
+        run(ExperimentConfig(max_steps_per_lease=5))
+    with pytest.raises(ValueError, match="max-steps-per-lease"):
+        run(_econfig(tmp_path, max_steps_per_lease=-1))
+
+
+def test_harness_sigterm_preemption_notice_drains(tmp_path):
+    """The in-process rendering of the CI smoke's kill -TERM: a SIGTERM
+    delivered while the harness trains drains gracefully — structured
+    `preempted` summary, final checkpoint on disk, process alive."""
+    from distributed_tensorflow_tpu.utils.harness import run
+
+    timer = threading.Timer(1.0, os.kill,
+                            args=(os.getpid(), signal.SIGTERM))
+    timer.daemon = True
+    timer.start()
+    try:
+        s = run(_econfig(tmp_path, epochs=500))  # far longer than the timer
+    finally:
+        timer.cancel()
+    assert s["preempted"] == "signal:SIGTERM"
+    assert s["run_report"]["lease"]["preempt_signal"] == "SIGTERM"
+    assert s["run_report"]["lease"]["signal_handler_installed"] is True
+    # SIGTERM's default disposition is restored: we are alive to assert
+    ck = tmp_path / "ck"
+    assert any(p.name.startswith("step_") for p in ck.iterdir())
+
+
+def test_supervisor_protocol_preempted_message(tmp_path):
+    """Satellite (supervisor integration): an external reference-style
+    listener sees ['preempted', reason, step] — a planned drain, not a
+    dead socket — alongside the reference event triple."""
+    from distributed_tensorflow_tpu.utils.harness import run
+    from distributed_tensorflow_tpu.utils.supervisor import (
+        SupervisorListener)
+
+    listener = SupervisorListener()
+    s = run(_econfig(tmp_path, max_steps_per_lease=4,
+                     supervisor_address=f"127.0.0.1:{listener.port}"))
+    listener.close()
+    assert s["preempted"]
+    assert listener.messages[0] == "start"
+    preempt = [m for m in listener.messages
+               if isinstance(m, list) and m[0] == "preempted"]
+    assert preempt == [["preempted", s["preempted"], s["steps"]]]
+
+
+def test_run_with_recovery_fault_injection_continuity(tmp_path):
+    """Satellite (failure integration): a worker killed mid-run recovers
+    through the ELASTIC restore — run_with_recovery relaunches with
+    elastic_restore=True, the resumed run continues the exact step/loss
+    trajectory (bitwise vs the uninterrupted run's metric stream), and
+    the report accounts the crash (resume_replay_steps == 0)."""
+    from distributed_tensorflow_tpu.utils import harness
+    from distributed_tensorflow_tpu.utils.failure import run_with_recovery
+
+    m0 = tmp_path / "uninterrupted.jsonl"
+    harness.run(_econfig(tmp_path / "u", metrics_path=str(m0)))
+    traj_u = [(r["step"], r["loss"])
+              for r in map(json.loads, m0.read_text().splitlines())]
+    assert [s for s, _ in traj_u] == list(range(1, 17))
+
+    m1, m2 = tmp_path / "crashed.jsonl", tmp_path / "resumed.jsonl"
+    cfg = _econfig(tmp_path, metrics_path=str(m2), max_steps_per_lease=8)
+    attempts = []
+
+    def killed_mid_run(config):
+        attempts.append((config.resume, config.elastic_restore))
+        if len(attempts) == 1:
+            # the injected death: train 8 steps (checkpoints at 4, 8),
+            # then die like a preempted worker — no drain, no cleanup
+            harness.run(dataclasses.replace(
+                config, metrics_path=str(m1), max_steps_per_lease=8))
+            raise RuntimeError("injected worker death mid-chunk")
+        return harness.run(config)
+
+    out = run_with_recovery(cfg, max_restarts=1, run_fn=killed_mid_run)
+    # the restart went through the elastic path, not a cold restore
+    assert attempts == [(False, False), (True, True)]
+    assert out["restarts"] == 1
+    assert out["run_report"]["restored_step"] == 8
+    assert out["run_report"]["resume_replay_steps"] == 0
+    traj_r = [(r["step"], r["loss"])
+              for r in map(json.loads,
+                           m1.read_text().splitlines()
+                           + m2.read_text().splitlines())]
+    assert traj_r == traj_u  # step AND loss continuity, bitwise
+
+
+def test_cli_flags_wire_through(tmp_path):
+    """--elastic-restore / --max-steps-per-lease reach the config."""
+    from distributed_tensorflow_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["--elastic-restore", "--max-steps-per-lease", "9"])
+    assert args.elastic_restore is True
+    assert args.max_steps_per_lease == 9
+    args = build_parser().parse_args([])
+    assert args.elastic_restore is False
+    assert args.max_steps_per_lease == 0
+
+
+# ------------------------------------------------------- analyze gating
+
+def test_analyze_diff_gates_preemption_keys():
+    from distributed_tensorflow_tpu.observability.analyze import (
+        diff_reports)
+
+    base = {"preemption_lost_s": 10.0, "resume_replay_steps": 0,
+            "straggler_events": 1}
+    worse = {"preemption_lost_s": 30.0, "resume_replay_steps": 8,
+             "straggler_events": 5}
+    d = diff_reports(base, worse)
+    regressed = {r["metric"] for r in d["regressions"]}
+    assert {"preemption_lost_s", "resume_replay_steps",
+            "straggler_events"} <= regressed
+    better = diff_reports(worse, base)
+    assert not better["regressions"]
+
+
+def test_analyze_flattens_straggler_events():
+    from distributed_tensorflow_tpu.observability.analyze import (
+        load_report)
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump({"stragglers": {"events": 3, "observed": 10}}, f)
+        path = f.name
+    try:
+        assert load_report(path)["straggler_events"] == 3
+    finally:
+        os.unlink(path)

@@ -100,7 +100,7 @@ def test_run_with_recovery_restarts_with_resume(tmp_path):
     calls = []
 
     def flaky_run(config):
-        calls.append(config.resume)
+        calls.append((config.resume, config.elastic_restore))
         if len(calls) < 3:
             raise RuntimeError(f"crash {len(calls)}")
         return {"ok": True}
@@ -109,7 +109,9 @@ def test_run_with_recovery_restarts_with_resume(tmp_path):
     out = run_with_recovery(cfg, max_restarts=2, run_fn=flaky_run,
                             on_restart=lambda n, e: restarts.append(str(e)))
     assert out == {"ok": True, "restarts": 2}
-    assert calls == [False, True, True]  # resume flips on after first crash
+    # the restart is the ELASTIC resume (resharding + data state), not a
+    # cold restore: both flags flip on after the first crash
+    assert calls == [(False, False), (True, True), (True, True)]
     assert restarts == ["crash 1", "crash 2"]
 
 
