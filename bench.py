@@ -1218,12 +1218,19 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
     budget, percentile accounting.
 
     TTFT/ITL are MLPerf-style latency percentiles (queue wait included in
-    TTFT); the headline is requests/sec/chip.  ``--stream`` exercises the
-    per-token streaming delivery hook (tokens reach the host every decode
-    iteration in both modes; --stream additionally counts deliveries
-    through the callback) and emits the same serve_* key set.  Smoke runs
-    shrink the workload via BENCH_SERVE_* env vars (model dims, slots,
-    request count, arrival rate) exactly like BENCH_PER_CHIP_BATCH."""
+    TTFT); the headline is requests/sec/chip.  Round 10: the default
+    workload carries a shared system prefix and periodic 2×-length
+    prompts, and the production windows run chunked prefill + the prefix
+    pool — a monolithic/no-cache continuous run on the SAME seeded trace
+    rides the line (``monolithic_itl_p95_s``/``monolithic_ttft_p50_s``)
+    so the decode-interference and shared-prompt claims are measured,
+    not asserted, plus the prefill/decode token split and the pool hit
+    rate.  ``--stream`` exercises the per-token streaming delivery hook
+    (tokens reach the host every decode iteration in all modes; --stream
+    additionally counts deliveries through the callback) and emits the
+    same key set.  Smoke runs shrink the workload via BENCH_SERVE_* env
+    vars (model dims, slots, request count, arrival rate, chunk/pool
+    shape) exactly like BENCH_PER_CHIP_BATCH."""
     import jax
     import jax.numpy as jnp
 
@@ -1250,6 +1257,18 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
     n_requests = int(env("BENCH_SERVE_REQUESTS", "32"))
     rate = float(env("BENCH_SERVE_RATE", "4"))  # requests/sec, open loop
     repeats = int(env("BENCH_SERVE_REPEATS", "3"))
+    # round-10 workload shape + serving optimizations (defaults model the
+    # dominant real-traffic pattern: a shared system prompt on every
+    # request, an occasional long prompt that would stall decode):
+    # chunked prefill budget (0 = monolithic), prefix-pool capacity in
+    # blocks (0 = off), block granularity, shared-prefix length, and
+    # every LONG_EVERY-th request carrying a 2×-length prompt
+    chunk = int(env("BENCH_SERVE_PREFILL_CHUNK", "16"))
+    cache_blocks = int(env("BENCH_SERVE_PREFIX_CACHE", "128"))
+    prefix_block = int(env("BENCH_SERVE_PREFIX_BLOCK", "8"))
+    shared_len = int(env("BENCH_SERVE_SHARED_PREFIX",
+                         str(prompt_len // 2)))
+    long_every = int(env("BENCH_SERVE_LONG_EVERY", "4"))
 
     mesh = with_backend_retry(meshlib.create_mesh)
     n = mesh.shape[meshlib.DATA_AXIS]
@@ -1257,7 +1276,8 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
         slots = ((slots + n - 1) // n) * n  # slot dim shards over 'data'
     device_kind = jax.devices()[0].device_kind
 
-    max_len = prompt_len + max_new
+    long_len = 2 * prompt_len
+    max_len = shared_len + long_len + max_new
     model = create_model("gpt", num_classes=vocab, hidden=hidden,
                          layers=layers, heads=heads, ffn=ffn,
                          max_len=max_len, dropout_rate=0.0,
@@ -1274,14 +1294,21 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
     _sync(params)
     note(f"init done in {time.perf_counter() - t0:.0f}s")
 
-    # one open-loop arrival trace shared by BOTH modes and ALL windows:
+    # one open-loop arrival trace shared by EVERY mode and ALL windows:
     # Poisson arrivals at `rate`, mixed prompt and continuation lengths
-    # (the staggered-traffic shape static batching idles on)
+    # (the staggered-traffic shape static batching idles on), a shared
+    # system prefix on every prompt (the shape the prefix pool exists
+    # for), and every `long_every`-th request carrying a 2× prompt (the
+    # arrival monolithic prefill stalls decode on)
     arrivals = rng.exponential(1.0 / max(rate, 1e-9), n_requests).cumsum()
     p_lens = rng.integers(max(prompt_len // 2, 1), prompt_len + 1,
                           n_requests)
+    if long_every:
+        p_lens[::long_every] = long_len
     n_news = rng.integers(max(max_new // 2, 1), max_new + 1, n_requests)
-    prompts = [rng.integers(0, vocab, pl).astype(np.int32)
+    shared = rng.integers(0, vocab, shared_len).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rng.integers(0, vocab, pl).astype(np.int32)])
                for pl in p_lens]
 
     def workload():
@@ -1290,18 +1317,59 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
                         arrival_s=float(arrivals[i]))
                 for i in range(n_requests)]
 
-    kv = SlotKVCache(model, params, slots, mesh=mesh)
+    # two tables, one workload: `kv` runs the round-10 production path
+    # (chunk-resumable prefill + prefix pool); `kv_base` runs the
+    # monolithic/no-cache programs for the chunked-vs-monolithic and
+    # continuous-vs-static comparisons on the SAME seeded trace
+    kv = SlotKVCache(model, params, slots, mesh=mesh,
+                     prefix_cache_blocks=cache_blocks,
+                     prefix_block=prefix_block)
+    kv_base = SlotKVCache(model, params, slots, mesh=mesh)
 
     def _warm():
-        # compile the decode step + every prefill bucket the workload
-        # will hit, outside the timed windows (first-request TTFT must
-        # measure serving, not XLA)
+        # compile the decode step + every prefill bucket AND chunk bucket
+        # the workload can hit, outside the timed windows (first-request
+        # TTFT must measure serving, not XLA).  Chunk tails bucket to
+        # powers of two ≤ the budget, and a prefix hit can shift the
+        # resume point anywhere, so warm every power-of-two bucket.
         lens = [len(p) for p in prompts]
         for plen in sorted(set(lens)):
-            slot, _ = kv.insert(prompts[lens.index(plen)])
+            slot, _ = kv_base.insert(prompts[lens.index(plen)])
+            kv_base.advance()
+            kv_base.evict(slot)
+        buckets = [chunk] if chunk else []
+        b = 1
+        while chunk and b < chunk:
+            buckets.append(b)
+            b *= 2
+        for blen in sorted(set(buckets)):
+            slot, _ = kv.begin_insert(
+                rng.integers(0, vocab, blen).astype(np.int32))
+            while kv.prefill_chunk(slot, chunk or None) is None:
+                pass
             kv.advance()
             kv.evict(slot)
-        note(f"warm: {kv.compiled_programs()}")
+        if not chunk:
+            for plen in sorted(set(lens)):
+                slot, _ = kv.insert(prompts[lens.index(plen)])
+                kv.advance()
+                kv.evict(slot)
+        if cache_blocks:
+            # force one pool HIT so the block-restore program compiles
+            # here too (the read side compiled when the admissions above
+            # pooled their blocks; the write side only runs on a hit —
+            # without this, the first shared-prefix request of window 1
+            # pays its XLA compile inside the measured TTFT)
+            longest = max(prompts, key=len)
+            for _ in range(2):
+                slot, _ = kv.begin_insert(longest)
+                while kv.prefill_chunk(slot, chunk or None) is None:
+                    pass
+                kv.advance()
+                kv.evict(slot)
+        kv.reset_prefix_cache()   # timed windows start with a cold pool
+        note(f"warm: production {kv.compiled_programs()}, "
+             f"baseline {kv_base.compiled_programs()}")
 
     with_backend_retry(_warm, "first compile/warmup")
 
@@ -1311,15 +1379,21 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
     on_token = ((lambda rid, tok: delivered.__setitem__(0, delivered[0] + 1))
                 if stream else None)
 
-    def window(mode):
+    def window(mode, table, budget, label):
         def _one(rep):
             delivered[0] = 0   # per-window count: the emitted number must
-            batcher = ContinuousBatcher(kv, tracer=tracer, mode=mode)
+            if table.prefix_cache_blocks:
+                # cold pool per window: the hit rate is then a
+                # deterministic property of the workload, not of how many
+                # windows ran before this one
+                table.reset_prefix_cache()
+            batcher = ContinuousBatcher(table, tracer=tracer, mode=mode,
+                                        prefill_chunk=budget)
             summary = serve_section(batcher.run(workload(),
                                                 on_token=on_token), n)
             if stream:         # describe ONE window, not every mode×repeat
                 summary["tokens_delivered"] = delivered[0]
-            note(f"{mode} window {rep}: "
+            note(f"{label} window {rep}: "
                  f"{summary['serve_requests_per_sec_per_chip']:.3f} "
                  f"req/s/chip, ttft_p95 "
                  f"{summary['serve_ttft_p95_s'] * 1e3:.1f} ms, "
@@ -1328,13 +1402,22 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
         return _one
 
     try:
-        cont = measure_windows(window("continuous"), repeats, "serve",
-                               partial_errors)
+        # production path: chunked prefill + prefix pool
+        cont = measure_windows(window("continuous", kv, chunk, "serve"),
+                               repeats, "serve", partial_errors)
         if not cont:
             raise RuntimeError(f"no serve window completed: "
                                f"{partial_errors[-1]}")
-        stat = measure_windows(window("static"), repeats, "serve_static",
-                               partial_errors)
+        # monolithic/no-cache continuous on the same trace — the
+        # chunked-vs-monolithic comparison (BASELINE.md "Prefill
+        # accounting": same arrivals, same per-iteration token budget
+        # question answered by the ITL/TTFT deltas, not throughput alone)
+        mono = measure_windows(
+            window("continuous", kv_base, 0, "serve_monolithic"),
+            repeats, "serve_monolithic", partial_errors)
+        stat = measure_windows(window("static", kv_base, 0,
+                                      "serve_static"),
+                               repeats, "serve_static", partial_errors)
     finally:
         # drain the span sink even when every window died — the spans up
         # to the failure are exactly the ones worth keeping
@@ -1347,22 +1430,49 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
     serve_keys = ("serve_requests_per_sec_per_chip",
                   "serve_requests_per_sec", "serve_tokens_per_sec",
                   "serve_ttft_p50_s", "serve_ttft_p95_s",
-                  "serve_itl_p50_s", "serve_itl_p95_s")
+                  "serve_itl_p50_s", "serve_itl_p95_s",
+                  # round 10: prefill/decode token split + prefix-pool
+                  # hit rate ride the default AND --stream lines, so the
+                  # BENCH_*.json serving trajectory captures them
+                  "serve_prefill_tokens_per_sec",
+                  "serve_decode_tokens_per_sec",
+                  "serve_prefix_cache_hit_rate")
     line = {k: med(cont, k) for k in serve_keys}
     rps = line["serve_requests_per_sec_per_chip"]
     static_rps = med(stat, "serve_requests_per_sec_per_chip")
+    mono_itl95 = med(mono, "serve_itl_p95_s")
+    mono_ttft50 = med(mono, "serve_ttft_p50_s")
     print(json.dumps({
         "metric": "gpt_serve_requests_per_sec_per_chip",
         "value": round(rps, 4) if rps else None,
         "unit": "requests/sec/chip",
         "vs_baseline": None,
         "method": (f"continuous batching, {slots} slots, open-loop "
-                   f"Poisson {rate}/s × {n_requests} requests, median "
+                   f"Poisson {rate}/s × {n_requests} requests "
+                   f"(shared {shared_len}-token prefix, 2× prompt every "
+                   f"{long_every}), chunked prefill {chunk} + prefix "
+                   f"cache {cache_blocks}×{prefix_block}, median "
                    f"of {len(cont)}"),
         **{k: (round(v, 6) if isinstance(v, float) else v)
            for k, v in line.items()},
         "serve_decode_iterations": med(cont, "decode_iterations"),
         "serve_completed": med(cont, "completed"),
+        "serve_prefill_chunks": med(cont, "prefill_chunks"),
+        # monolithic/no-cache continuous on the SAME trace: the ITL-p95
+        # and TTFT-p50 deltas are THE round-10 headline numbers (decode
+        # interference bounded by the chunk budget; shared prompts not
+        # recomputed)
+        "monolithic_itl_p95_s": mono_itl95,
+        "monolithic_ttft_p50_s": mono_ttft50,
+        "monolithic_requests_per_sec_per_chip": med(
+            mono, "serve_requests_per_sec_per_chip"),
+        "monolithic_decode_iterations": med(mono, "decode_iterations"),
+        "chunked_vs_monolithic_itl_p95": (
+            round(line["serve_itl_p95_s"] / mono_itl95, 3)
+            if line["serve_itl_p95_s"] and mono_itl95 else None),
+        "cached_vs_uncached_ttft_p50": (
+            round(line["serve_ttft_p50_s"] / mono_ttft50, 3)
+            if line["serve_ttft_p50_s"] and mono_ttft50 else None),
         # the static-batch generate baseline on the SAME arrival trace —
         # the headline claim is the ratio at equal latency budget
         "static_requests_per_sec_per_chip": (
@@ -1380,7 +1490,11 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
                    "max_new_tokens": max_new, "vocab": vocab,
                    "hidden": hidden, "layers": layers, "heads": heads,
                    "ffn": ffn, "max_len": max_len, "dtype": "bfloat16",
-                   "greedy": True},
+                   "greedy": True, "prefill_chunk": chunk,
+                   "prefix_cache_blocks": cache_blocks,
+                   "prefix_block": prefix_block,
+                   "shared_prefix": shared_len,
+                   "long_every": long_every, "long_len": long_len},
         "device": device_kind,
         "n_devices": n,
         "synthetic": True,
@@ -1389,6 +1503,7 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
         "libtpu_init_args": os.environ.get("LIBTPU_INIT_ARGS"),
         **({"partial": {"errors": partial_errors,
                         "serve_windows": len(cont),
+                        "monolithic_windows": len(mono),
                         "static_windows": len(stat)}}
            if partial_errors else {}),
     }))

@@ -196,6 +196,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "chip at equal HBM; greedy tokens stay oracle-"
                         "exact on the shipped models and the dtype is "
                         "surfaced in the serve report section")
+    p.add_argument("--serve-prefill-chunk", type=int, default=0,
+                   metavar="T",
+                   help="--serve: chunked prefill token budget (Sarathi-"
+                        "Serve): admissions prefill in chunks of ≤T "
+                        "tokens, at most one chunk per decode iteration, "
+                        "so a long prompt cannot stall live slots for "
+                        "more than one chunk per token.  0 (default) = "
+                        "monolithic prefill (the pre-round-10 programs, "
+                        "byte-identical).  Greedy tokens are identical "
+                        "either way; TTFT stays arrival→first-token")
+    p.add_argument("--serve-prefix-cache", type=int, default=0,
+                   metavar="BLOCKS",
+                   help="--serve: prefix-cache pool capacity in KV "
+                        "blocks (vLLM-style block-granular reuse).  On "
+                        "admission the longest cached block-aligned "
+                        "prompt prefix is copied into the slot and "
+                        "prefill starts at the first uncached block; "
+                        "LRU eviction past the bound.  0 (default) = "
+                        "off.  hit/miss/evict accounting + "
+                        "serve_prefix_cache_hit_rate ride the serve "
+                        "section (gated by `analyze diff`)")
+    p.add_argument("--serve-prefix-block", type=int, default=16,
+                   metavar="T",
+                   help="--serve: tokens per prefix-cache block (reuse "
+                        "granularity; only full blocks are pooled)")
+    p.add_argument("--serve-shared-prefix", type=int, default=0,
+                   metavar="T",
+                   help="--serve: prepend a fixed T-token synthetic "
+                        "system prompt to every request (the dominant "
+                        "real-traffic shape prefix caching exists for); "
+                        "deterministic from --seed")
     p.add_argument("--model-arg", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="extra model constructor field (repeatable), e.g. "
@@ -529,6 +560,10 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         serve_max_new=args.serve_max_new,
         serve_prompt_len=args.serve_prompt_len,
         serve_kv_dtype=args.serve_kv_dtype,
+        serve_prefill_chunk=args.serve_prefill_chunk,
+        serve_prefix_cache=args.serve_prefix_cache,
+        serve_prefix_block=args.serve_prefix_block,
+        serve_shared_prefix=args.serve_shared_prefix,
     )
     summary = run(config)  # run() itself wraps recovery when max_restarts>0
     print(json.dumps(summary))

@@ -169,6 +169,14 @@ class CausalSelfAttention(nn.Module):
                 # engine, which guards capacity at admission time
                 # (prompt + max_new_tokens ≤ max_len — the host-side
                 # twin of the scalar path's sticky overflow flag).
+                # CHUNK-RESUME CONTRACT: because the position is caller-
+                # supplied and validity is derived from it alone, prefill
+                # may stop at any position and resume later (chunked
+                # prefill) or start PAST zero over externally-written KV
+                # (a prefix-cache hit restores blocks 0..p-1 and resumes
+                # at p) — the per-token math is identical either way,
+                # which is what makes chunked admission bitwise equal to
+                # monolithic admission (tests/test_serving.py).
                 if pos is None:
                     raise ValueError(
                         "decode_slots=True needs per-slot positions "

@@ -310,6 +310,14 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     ("serve_tokens_per_sec", "higher"),
     ("serve_ttft_p50_s", "lower"), ("serve_ttft_p95_s", "lower"),
     ("serve_itl_p50_s", "lower"), ("serve_itl_p95_s", "lower"),
+    # chunked prefill + prefix caching (round 10): the prefill/decode
+    # token split and the prefix-pool hit rate are rates — all
+    # higher-is-better (NB every *_per_sec key here must be listed, or
+    # the `sec_per`-substring direction bug class regresses silently;
+    # the _value_direction unit tests pin each one)
+    ("serve_prefill_tokens_per_sec", "higher"),
+    ("serve_decode_tokens_per_sec", "higher"),
+    ("serve_prefix_cache_hit_rate", "higher"),
 )
 
 

@@ -5,11 +5,17 @@ Two halves:
 * ``kv_cache.SlotKVCache`` — the device half: a fixed slot table of KV
   buffers sharded over the training mesh, one compiled single-token decode
   step for the whole table, and a compiled per-bucket prefill-insert so
-  admission never recompiles decoding.
+  admission never recompiles decoding.  Chunk-resumable prefill
+  (``begin_insert``/``prefill_chunk``) splits an admission into fixed
+  token-budget chunks, and the optional block-granular prefix pool
+  (``prefix_cache_blocks``) reuses cached shared-prompt KV with LRU
+  eviction and hit/miss accounting.
 * ``scheduler.ContinuousBatcher`` — the host half: an iteration-level
-  request scheduler (admit between decode steps, evict finished slots)
-  with MLPerf-style TTFT/ITL percentile accounting and per-request trace
-  spans through the existing observability stack.
+  request scheduler (admit between decode steps, evict finished slots,
+  with ``prefill_chunk`` at most one prompt chunk interleaved per decode
+  iteration — Sarathi-Serve stall bounding) with MLPerf-style TTFT/ITL
+  percentile accounting, a prefill/decode token split, and per-request
+  trace spans through the existing observability stack.
 
 ``bench.py --serve`` drives an open-loop arrival process through both and
 reports requests/sec/chip + latency percentiles; the harness's ``--serve``

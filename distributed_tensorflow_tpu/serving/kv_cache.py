@@ -23,15 +23,33 @@ Device-side contract (everything else lives in serving/scheduler.py):
   the SAME per-token decode math inside a ``lax.scan`` over the padded
   prompt, against only that slot's cache slice (batch 1), then writes the
   slice back — compiled once per padded length bucket (powers of two), so
-  steady-state admission never triggers XLA.
+  steady-state admission never triggers XLA;
+* ``begin_insert``/``prefill_chunk`` split that admission into fixed
+  token-budget chunks (Sarathi-Serve, arXiv:2403.02310): each chunk resumes
+  at the slot's fill position (the chunk program takes a traced ``start``,
+  so ONE compile per power-of-two chunk-length bucket serves every resume
+  point), and the scheduler interleaves at most one chunk per decode
+  iteration — live slots keep emitting tokens while a long prompt fills;
+* the optional **prefix pool** (vLLM PagedAttention's block-granular KV
+  reuse, arXiv:2309.06180) caches block-aligned prompt-prefix KV keyed by
+  the exact token bytes of the prefix: on admission the longest cached
+  prefix is copied into the slot and prefill starts at the first uncached
+  block, with hit/miss/evict accounting and bounded LRU eviction.
 
 Greedy slot decode is token-identical to the sequential ``generate``
 sampler per request (tests/test_serving.py): prefill-at-position-t and
 decode-at-cursor-t run the same dense cache attention with the same
-length-driven validity mask.
+length-driven validity mask.  Chunked prefill is bitwise-identical to
+monolithic prefill (each token's forward depends only on cache positions
+below its own, all written by earlier chunks), and a prefix-cache hit is
+bitwise-identical to recomputation (the pooled KV is a byte copy of what
+the cold prefill would write).
 """
 
 from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -80,9 +98,16 @@ class SlotKVCache:
 
     def __init__(self, model: GPTLM, params, slots: int, *,
                  mesh=None, greedy: bool = True, temperature: float = 1.0,
-                 prefill_bucket: int = 8, rng=None, kv_dtype=None):
+                 prefill_bucket: int = 8, rng=None, kv_dtype=None,
+                 prefix_cache_blocks: int = 0, prefix_block: int = 16):
         if slots < 1:
             raise ValueError(f"slots must be positive, got {slots}")
+        if prefix_cache_blocks < 0:
+            raise ValueError(f"prefix_cache_blocks must be >= 0, got "
+                             f"{prefix_cache_blocks}")
+        if prefix_block < 1:
+            raise ValueError(f"prefix_block must be positive, got "
+                             f"{prefix_block}")
         self.slots = int(slots)
         self.max_len = int(model.max_len)
         self.greedy = bool(greedy)
@@ -148,13 +173,35 @@ class SlotKVCache:
         self.cache = cache
         self.params = params
 
-        # host-side slot table
+        # host-side slot table.  ``reserved`` marks slots claimed by an
+        # in-progress chunked admission (begin_insert): not free, but not
+        # yet advanced by decode — lengths[] tracks the fill position.
         self.lengths = np.zeros(self.slots, np.int32)
         self.active = np.zeros(self.slots, np.bool_)
+        self.reserved = np.zeros(self.slots, np.bool_)
         self.tokens = np.zeros(self.slots, np.int32)   # last token per slot
+        self._pending: dict[int, dict] = {}            # slot → prefill state
+
+        # block-aligned prefix pool (LRU over exact prefix-byte keys);
+        # entries are the slot-slice KV of one block, stored at the table's
+        # dtype so a hit writes back bitwise what the cold prefill wrote
+        self.prefix_cache_blocks = int(prefix_cache_blocks)
+        self.prefix_block = int(prefix_block)
+        self._prefix_pool: OrderedDict[bytes, object] = OrderedDict()
+        self.prefix_stats = {"hits": 0, "misses": 0, "evictions": 0,
+                             "tokens_reused": 0, "inserted_blocks": 0}
+
+        # prompt tokens actually fed through a prefill program (cached
+        # prefix blocks are skipped, pad tokens not counted) — the
+        # scheduler reads deltas of this for the prefill/decode token
+        # split and the VirtualClock interference model
+        self.prefill_tokens_computed = 0
 
         self._step = self._build_step()
         self._prefills: dict[int, object] = {}
+        self._chunks: dict[int, object] = {}           # chunk-resume prefill
+        self._read_block = None                        # prefix-pool extract
+        self._write_block = None                       # prefix-pool restore
 
     # ------------------------------------------------------------- programs
     def _sample(self, logits, rng):
@@ -218,10 +265,74 @@ class SlotKVCache:
 
         return jax.jit(prefill, donate_argnums=1)
 
+    def _chunk(self, lpad: int):
+        """Compiled chunk-resumable prefill for one padded CHUNK length.
+
+        Like ``_prefill`` but resumes at a traced ``start`` position
+        (positions ``start .. start+lpad-1``), so one compile per
+        power-of-two chunk bucket serves every resume point — a long
+        prompt's admission becomes several short scans the scheduler can
+        interleave with decode iterations.  ``n_valid`` is the chunk's
+        real token count; the sampled token (logits at the last valid
+        position) only matters on the FINAL chunk — it is the request's
+        first generated token, exactly as in the monolithic prefill.
+        Padding past ``n_valid`` writes garbage K/V that the next chunk
+        (which starts at ``start+n_valid``) or decode overwrites, and
+        out-of-range scatter rows are dropped — the same argument that
+        makes monolithic pad writes safe."""
+        dm = self.dm
+
+        def chunk(params, cache, slot, tokens, start, n_valid, rng):
+            sub = jax.tree.map(
+                lambda t: lax.dynamic_slice_in_dim(t, slot, 1, 0), cache)
+
+            def body(c, xs):
+                tok, t = xs
+                logits, upd = dm.apply(
+                    {"params": params, "cache": c}, tok[None, None],
+                    train=False, positions=t[None, None],
+                    mutable=["cache"])
+                return upd["cache"], logits[0, -1]
+
+            sub, all_logits = lax.scan(
+                body, sub,
+                (tokens, start + jnp.arange(lpad, dtype=jnp.int32)))
+            last = jnp.take(all_logits, n_valid - 1, axis=0)
+            first = self._sample(last[None, :], rng)[0]
+            cache = jax.tree.map(
+                lambda full, s: lax.dynamic_update_slice_in_dim(
+                    full, s, slot, 0), cache, sub)
+            return cache, first.astype(tokens.dtype)
+
+        return jax.jit(chunk, donate_argnums=1)
+
+    def _block_ops(self):
+        """Jitted prefix-pool block copy programs, compiled once each
+        (slot/start are traced): ``read`` slices one block of a slot's KV
+        out of every cache leaf; ``write`` scatters a pooled block back
+        into a (possibly different) slot.  Cache leaves in slot-decode
+        mode are all (slots, max_len, kv_heads, head_dim)."""
+        blk = self.prefix_block
+
+        def read(cache, slot, start):
+            return jax.tree.map(
+                lambda t: lax.dynamic_slice(
+                    t, (slot, start, 0, 0),
+                    (1, blk, t.shape[2], t.shape[3])), cache)
+
+        def write(cache, entry, slot, start):
+            return jax.tree.map(
+                lambda t, e: lax.dynamic_update_slice(
+                    t, e.astype(t.dtype), (slot, start, 0, 0)),
+                cache, entry)
+
+        return jax.jit(read), jax.jit(write, donate_argnums=0)
+
     # ------------------------------------------------------------ slot API
     @property
     def free_slots(self) -> list[int]:
-        return [i for i in range(self.slots) if not self.active[i]]
+        return [i for i in range(self.slots)
+                if not (self.active[i] or self.reserved[i])]
 
     def _put_vec(self, arr):
         arr = jnp.asarray(arr)
@@ -244,14 +355,9 @@ class SlotKVCache:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def insert(self, prompt, slot: int | None = None) -> tuple[int, int]:
-        """Admit a prompt into a free slot (jitted prefill-insert).
-
-        Returns ``(slot, first_token)`` — the first generated token is
-        sampled by the prefill itself (its wall time IS the time-to-first-
-        token), and the slot's length becomes ``len(prompt)``: the first
-        decode step will write the returned token's K/V at that position.
-        """
+    def _claim_slot(self, prompt, slot: int | None) -> tuple[np.ndarray,
+                                                             int, int]:
+        """Shared admission validation: returns (prompt, lp, slot)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         lp = int(prompt.shape[0])
         if lp < 1:
@@ -265,8 +371,42 @@ class SlotKVCache:
             if not free:
                 raise RuntimeError("no free slot — evict before inserting")
             slot = free[0]
-        elif self.active[slot]:
+        elif self.active[slot] or self.reserved[slot]:
             raise RuntimeError(f"slot {slot} is active — evict it first")
+        return prompt, lp, slot
+
+    def insert(self, prompt, slot: int | None = None) -> tuple[int, int]:
+        """Admit a prompt into a free slot (jitted prefill-insert).
+
+        Returns ``(slot, first_token)`` — the first generated token is
+        sampled by the prefill itself (its wall time IS the time-to-first-
+        token), and the slot's length becomes ``len(prompt)``: the first
+        decode step will write the returned token's K/V at that position.
+
+        With the prefix pool enabled, admission routes through the
+        chunk-resumable program (``begin_insert`` + one full-remainder
+        ``prefill_chunk``) so prefill can start at the first uncached
+        block; with the pool off, this is the byte-identical PR 7 path.
+        """
+        if self.prefix_cache_blocks:
+            slot, _ = self.begin_insert(prompt, slot)
+            try:
+                first = self.prefill_chunk(slot)
+            except BaseException:
+                # the reservation is internal to this call — release
+                # whichever state the slot reached so a failed admission
+                # cannot leak it (a failure INSIDE the final chunk may
+                # land after the slot already activated, e.g. in
+                # _pool_prefix; aborting a no-longer-pending slot would
+                # raise over the real error)
+                if self.has_pending(slot):
+                    self.abort_insert(slot)
+                elif self.active[slot]:
+                    self.evict(slot)
+                raise
+            assert first is not None  # uncapped chunk = whole remainder
+            return slot, first
+        prompt, lp, slot = self._claim_slot(prompt, slot)
         lpad = _bucket(lp, self.prefill_bucket, self.max_len)
         padded = np.zeros(lpad, np.int32)
         padded[:lp] = prompt
@@ -276,10 +416,192 @@ class SlotKVCache:
         self.cache, first = fn(
             self.params, self.cache, jnp.int32(slot),
             self._put_repl(padded), jnp.int32(lp), self._next_rng())
+        self.prefill_tokens_computed += lp
         self.active[slot] = True
         self.lengths[slot] = lp
         self.tokens[slot] = first = int(first)
         return slot, first
+
+    # ------------------------------------------- chunked (resumable) prefill
+    def begin_insert(self, prompt,
+                     slot: int | None = None) -> tuple[int, int]:
+        """Claim a slot for a chunk-by-chunk admission; returns
+        ``(slot, reused_tokens)``.
+
+        The slot is RESERVED (not free, not decoded) until the final
+        ``prefill_chunk`` activates it.  With the prefix pool enabled, the
+        longest cached block-aligned prefix is copied into the slot here
+        and ``reused_tokens`` positions are skipped — prefill resumes at
+        the first uncached block.  At least the prompt's final token is
+        always computed (its logits sample the first generated token)."""
+        prompt, lp, slot = self._claim_slot(prompt, slot)
+        reused = self._restore_prefix(prompt, lp, slot)
+        self.reserved[slot] = True
+        self.lengths[slot] = reused
+        self._pending[slot] = {"prompt": prompt, "lp": lp, "filled": reused}
+        return slot, reused
+
+    def prefill_chunk(self, slot: int,
+                      max_tokens: int | None = None) -> int | None:
+        """Process the next ≤ ``max_tokens`` prompt tokens of a pending
+        admission (one jitted chunk scan, compiled per power-of-two chunk
+        bucket).  Returns the request's first generated token when this
+        was the final chunk (the slot becomes active, exactly as after
+        ``insert``), else None."""
+        pend = self._pending.get(slot)
+        if pend is None:
+            raise RuntimeError(f"slot {slot} has no pending admission "
+                               f"(begin_insert first)")
+        filled, lp = pend["filled"], pend["lp"]
+        n = lp - filled
+        if max_tokens is not None:
+            if max_tokens < 1:
+                raise ValueError(
+                    f"max_tokens must be positive, got {max_tokens}")
+            n = min(n, int(max_tokens))
+        final = filled + n == lp
+        # chunk bucket floor is 1 (not prefill_bucket): budgets below the
+        # admission floor must not round the chunk back up past the
+        # scheduler's per-iteration token budget
+        lpad = _bucket(n, 1, self.max_len)
+        padded = np.zeros(lpad, np.int32)
+        padded[:n] = pend["prompt"][filled:filled + n]
+        if lpad not in self._chunks:
+            self._chunks[lpad] = self._chunk(lpad)
+        self.cache, first = self._chunks[lpad](
+            self.params, self.cache, jnp.int32(slot),
+            self._put_repl(padded), jnp.int32(filled), jnp.int32(n),
+            self._next_rng())
+        pend["filled"] = filled + n
+        self.lengths[slot] = filled + n
+        self.prefill_tokens_computed += n
+        if not final:
+            return None
+        # materialize the token BEFORE flipping host state: a deferred
+        # device error surfaces here while the slot is still pending, so
+        # the caller's abort path sees a consistent table
+        first = int(first)
+        del self._pending[slot]
+        self.reserved[slot] = False
+        self.active[slot] = True
+        self.lengths[slot] = lp
+        self.tokens[slot] = first
+        self._pool_prefix(pend["prompt"], lp, slot)
+        return first
+
+    def pending_tokens(self, slot: int) -> int:
+        """Prompt tokens a pending admission still has to prefill."""
+        pend = self._pending[slot]
+        return pend["lp"] - pend["filled"]
+
+    def has_pending(self, slot: int) -> bool:
+        """Whether ``slot`` holds an in-progress (begin_insert) admission."""
+        return slot in self._pending
+
+    def abort_insert(self, slot: int) -> None:
+        """Release a reserved slot whose admission will not complete (the
+        scheduler's mid-run-failure cleanup path)."""
+        if slot not in self._pending:
+            raise RuntimeError(f"slot {slot} has no pending admission")
+        del self._pending[slot]
+        self.reserved[slot] = False
+        self.lengths[slot] = 0
+
+    # ------------------------------------------------------- prefix pool
+    def _prefix_keys(self, prompt: np.ndarray, n_blocks: int):
+        """Chained block keys: block b's key is SHA-256 of (block b-1's
+        key ‖ block b's token bytes), so the 32-byte digest carries the
+        FULL prefix identity — a block matches only when every block
+        before it matched — at O(L) total work and constant key size
+        (hashing the raw whole-prefix bytes per block would be O(L²)
+        per admission and store megabytes of keys for long chains)."""
+        blk = self.prefix_block
+        keys, prev = [], b""
+        for b in range(n_blocks):
+            h = hashlib.sha256(prev)
+            h.update(prompt[b * blk:(b + 1) * blk].tobytes())
+            prev = h.digest()
+            keys.append(prev)
+        return keys
+
+    def _restore_prefix(self, prompt: np.ndarray, lp: int,
+                        slot: int) -> int:
+        """Copy the longest cached block-aligned prefix into ``slot``;
+        returns the number of reused token positions.  Reuse is capped at
+        the blocks covering ``lp - 1`` tokens: the final prompt token is
+        always recomputed so its logits can sample the first token."""
+        if not self.prefix_cache_blocks:
+            return 0
+        blk = self.prefix_block
+        usable = (lp - 1) // blk    # full blocks strictly before the tail
+        insertable = lp // blk      # full blocks the prompt will pool
+        keys = self._prefix_keys(prompt, usable)
+        matched = 0
+        for key in keys:
+            if key not in self._prefix_pool:
+                break
+            matched += 1
+        self.prefix_stats["hits"] += matched
+        self.prefix_stats["misses"] += insertable - matched
+        self.prefix_stats["tokens_reused"] += matched * blk
+        if not matched:
+            return 0
+        if self._write_block is None:
+            self._read_block, self._write_block = self._block_ops()
+        for b, key in enumerate(keys[:matched]):
+            self._prefix_pool.move_to_end(key)   # LRU touch
+            self.cache = self._write_block(
+                self.cache, self._prefix_pool[key], jnp.int32(slot),
+                jnp.int32(b * blk))
+        return matched * blk
+
+    def _pool_prefix(self, prompt: np.ndarray, lp: int, slot: int) -> None:
+        """After a completed prefill, pool every full block of the prompt
+        not already cached (extracted from the slot's freshly-written KV),
+        evicting least-recently-used entries past the pool bound."""
+        if not self.prefix_cache_blocks:
+            return
+        blk = self.prefix_block
+        if self._read_block is None:
+            self._read_block, self._write_block = self._block_ops()
+        for b, key in enumerate(self._prefix_keys(prompt, lp // blk)):
+            if key in self._prefix_pool:
+                self._prefix_pool.move_to_end(key)
+                continue
+            entry = self._read_block(
+                self.cache, jnp.int32(slot), jnp.int32(b * blk))
+            if self.mesh is not None:
+                # pool entries replicate: a block extracted from one data
+                # shard's slot row gets written into ANY slot later, so
+                # leaving it pinned to the source shard would force XLA
+                # into a resharding rematerialization on every hit
+                repl = NamedSharding(self.mesh, P())
+                entry = jax.tree.map(
+                    lambda t: jax.device_put(t, repl), entry)
+            self._prefix_pool[key] = entry
+            self.prefix_stats["inserted_blocks"] += 1
+        while len(self._prefix_pool) > self.prefix_cache_blocks:
+            self._prefix_pool.popitem(last=False)
+            self.prefix_stats["evictions"] += 1
+
+    def prefix_cache_stats(self) -> dict | None:
+        """Cumulative hit/miss/evict accounting (None when the pool is
+        off).  ``hit_rate`` is block-level: reused blocks over reusable +
+        pooled blocks."""
+        if not self.prefix_cache_blocks:
+            return None
+        s = dict(self.prefix_stats)
+        total = s["hits"] + s["misses"]
+        s["cached_blocks"] = len(self._prefix_pool)
+        s["hit_rate"] = s["hits"] / total if total else 0.0
+        return s
+
+    def reset_prefix_cache(self) -> None:
+        """Drop pooled blocks and zero the accounting (bench windows call
+        this so per-window hit rates are deterministic)."""
+        self._prefix_pool.clear()
+        for k in self.prefix_stats:
+            self.prefix_stats[k] = 0
 
     def advance(self) -> np.ndarray:
         """One decode iteration: every ACTIVE slot consumes its last token
@@ -312,6 +634,12 @@ class SlotKVCache:
         self.tokens[slot] = 0
 
     def compiled_programs(self) -> dict[str, int]:
-        """{decode_steps: 1, prefill_buckets: N} — the recompile-freedom
-        invariant the tests pin down."""
-        return {"decode_steps": 1, "prefill_buckets": len(self._prefills)}
+        """The recompile-freedom invariant the tests pin down: one decode
+        step, one prefill program per power-of-two bucket, one chunk
+        program per power-of-two CHUNK bucket, and at most the two prefix
+        block-copy programs.  With chunking and the prefix pool off, the
+        chunk/block counts are 0 and the compiled set is exactly PR 7's."""
+        return {"decode_steps": 1,
+                "prefill_buckets": len(self._prefills),
+                "prefill_chunk_buckets": len(self._chunks),
+                "prefix_block_ops": (0 if self._read_block is None else 2)}

@@ -9,6 +9,17 @@ loop to the restart-per-batch ``generate`` baseline — admission only when
 the table is empty — so continuous-vs-static comparisons share every line
 of device code and the decode-iteration counter is directly comparable.
 
+``prefill_chunk > 0`` enables Sarathi-Serve-style chunked prefill
+(arXiv:2403.02310): admission only CLAIMS the slot; the prompt then fills
+in ≤budget-token chunks, at most one chunk per loop iteration, so a long
+prompt's prefill is spread across decode iterations instead of stalling
+every live slot in one gap.  The first token is still sampled by the
+final chunk — TTFT keeps its arrival→first-token meaning, queue wait and
+chunk wait both included.  The summary splits throughput into
+``serve_prefill_tokens_per_sec`` / ``serve_decode_tokens_per_sec`` and,
+when the SlotKVCache's prefix pool is on, carries the run's block-level
+``serve_prefix_cache_hit_rate`` with the hit/miss/evict ledger.
+
 The request queue rebuilds the claim discipline of the unwired native
 batch pipeline (native/batcher.py): one consumer claims the queue for a
 run and releases it deterministically on exit, so two schedulers can never
@@ -46,10 +57,16 @@ from distributed_tensorflow_tpu.serving.kv_cache import SlotKVCache
 # ------------------------------------------------------------------ clocks
 
 class WallClock:
-    """Real time: arrivals are seconds since ``start()``; idle waits sleep."""
+    """Real time: arrivals are seconds since ``start()``; idle waits sleep.
 
-    def __init__(self):
+    ``poll_slice_s`` bounds each idle sleep: the batcher re-checks the
+    queue between slices (a concurrent producer's earlier arrival is
+    noticed within one slice) instead of either spinning or oversleeping.
+    """
+
+    def __init__(self, poll_slice_s: float = 0.05):
         self._t0 = None
+        self.poll_slice_s = float(poll_slice_s)
 
     def start(self) -> None:
         self._t0 = time.monotonic()
@@ -58,6 +75,9 @@ class WallClock:
         return time.monotonic() - self._t0
 
     def on_decode_iteration(self) -> None:
+        pass  # real time advances itself
+
+    def on_prefill(self, tokens: int) -> None:
         pass  # real time advances itself
 
     def wait_until(self, t: float) -> None:
@@ -71,11 +91,22 @@ class VirtualClock:
 
     Arrival times are then expressed in decode iterations, which makes
     "request arrives mid-decode" an exact, repeatable event — the
-    staggered-arrival acceptance tests run on this clock."""
+    staggered-arrival acceptance tests run on this clock.
 
-    def __init__(self, tick: float = 1.0):
+    ``prefill_token_tick`` is the interference cost model: each prefilled
+    prompt token advances time by this much (default 0 — prefill is free,
+    the PR 7 accounting).  With it set, a monolithic admission of an
+    L-token prompt stalls every live slot by ``L × prefill_token_tick``
+    in one gap, while chunked prefill bounds the per-iteration stall to
+    ``budget × prefill_token_tick`` — the chunked-prefill acceptance
+    tests measure exactly that, deterministically."""
+
+    poll_slice_s = float("inf")   # virtual idle waits jump, never slice
+
+    def __init__(self, tick: float = 1.0, prefill_token_tick: float = 0.0):
         self.t = 0.0
         self.tick = float(tick)
+        self.prefill_token_tick = float(prefill_token_tick)
 
     def start(self) -> None:
         self.t = 0.0
@@ -85,6 +116,9 @@ class VirtualClock:
 
     def on_decode_iteration(self) -> None:
         self.t += self.tick
+
+    def on_prefill(self, tokens: int) -> None:
+        self.t += tokens * self.prefill_token_tick
 
     def wait_until(self, t: float) -> None:
         self.t = max(self.t, t)
@@ -107,13 +141,16 @@ class RequestQueue:
     """Arrival-ordered queue with the native batcher's busy-claim contract
     (native/batcher.py: one consumer owns the cursor; release is
     deterministic, not GC-time).  ``claim()`` returns a context manager —
-    a second concurrent scheduler on the same queue raises instead of
-    silently interleaving admissions."""
+    a second concurrent scheduler on the same queue performs a BOUNDED
+    busy-claim (short doubling backoff sleeps, never a hot spin, attempt
+    count pinned by tests) and raises once the bound is exhausted instead
+    of silently interleaving admissions."""
 
     def __init__(self, requests: Iterable[Request] = ()):
         self._items: list[Request] = sorted(
             requests, key=lambda r: (r.arrival_s, r.rid))
         self.busy = False
+        self.claim_attempts = 0   # attempts of the LAST claim() call
 
     def push(self, request: Request) -> None:
         self._items.append(request)
@@ -131,11 +168,28 @@ class RequestQueue:
         return None
 
     @contextlib.contextmanager
-    def claim(self):
-        if self.busy:
-            raise RuntimeError(
-                "RequestQueue is busy: another scheduler run owns it "
-                "(the native/batcher.py single-consumer claim contract)")
+    def claim(self, max_attempts: int = 8, backoff_s: float = 0.005):
+        """Claim the queue for one scheduler run.
+
+        A busy queue is retried ``max_attempts`` times with a short
+        doubling sleep between attempts (bounded host cost — the claim
+        loop can never spin a core), then raises.  ``claim_attempts``
+        records how many attempts the call made, so tests pin the bound.
+        """
+        delay = float(backoff_s)
+        self.claim_attempts = 0
+        while True:
+            self.claim_attempts += 1
+            if not self.busy:
+                break
+            if self.claim_attempts >= max_attempts:
+                raise RuntimeError(
+                    "RequestQueue is busy: another scheduler run owns it "
+                    "(the native/batcher.py single-consumer claim "
+                    f"contract; gave up after {self.claim_attempts} "
+                    f"bounded claim attempts)")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.1)
         self.busy = True
         try:
             yield self
@@ -199,31 +253,49 @@ class ContinuousBatcher:
     """
 
     def __init__(self, kv: SlotKVCache, *, tracer=NULL_TRACER,
-                 clock=None, mode: str = "continuous"):
+                 clock=None, mode: str = "continuous",
+                 prefill_chunk: int = 0):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be continuous|static, got {mode}")
+        if prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0 (0 = monolithic prefill), "
+                f"got {prefill_chunk}")
         self.kv = kv
         self.tracer = tracer
         self.clock = clock if clock is not None else WallClock()
         self.mode = mode
+        # per-iteration prompt-token budget (Sarathi-Serve chunked
+        # prefill): 0 = admission prefills the whole prompt in one program
+        # (the PR 7 path); >0 = at most one ≤prefill_chunk-token chunk
+        # rides each decode iteration, so live slots keep emitting tokens
+        # while a long prompt fills
+        self.prefill_chunk = int(prefill_chunk)
+        self.idle_polls = 0
 
     # ------------------------------------------------------------ admission
-    def _admit(self, req: Request, live: dict[int, _Live]) -> int:
-        kv, tracer = self.kv, self.tracer
+    def _check_capacity(self, req: Request) -> int:
         lp = int(np.asarray(req.prompt).reshape(-1).shape[0])
-        if lp + req.max_new_tokens > kv.max_len:
+        if lp + req.max_new_tokens > self.kv.max_len:
             raise ValueError(
                 f"request {req.rid}: prompt ({lp}) + max_new_tokens "
                 f"({req.max_new_tokens}) exceeds the slot capacity "
-                f"max_len={kv.max_len}")
+                f"max_len={self.kv.max_len}")
         if req.max_new_tokens < 1:
             raise ValueError(
                 f"request {req.rid}: max_new_tokens must be positive")
+        return lp
+
+    def _admit(self, req: Request, live: dict[int, _Live]) -> int:
+        kv, tracer = self.kv, self.tracer
+        lp = self._check_capacity(req)
         req_span = tracer.span("request", rid=req.rid, prompt_len=lp,
                                max_new_tokens=req.max_new_tokens)
         req_span.__enter__()
+        before = kv.prefill_tokens_computed
         with tracer.span("prefill", rid=req.rid, prompt_len=lp):
             slot, first = kv.insert(req.prompt)
+        self.clock.on_prefill(kv.prefill_tokens_computed - before)
         now = self.clock.now()
         result = RequestResult(
             rid=req.rid, prompt_len=lp, tokens=[first],
@@ -236,6 +308,35 @@ class ContinuousBatcher:
             # the whole continuation — finish without a decode iteration
             self._finish(slot, live)
         return first
+
+    def _begin_admit(self, req: Request, pending: dict[int, dict]) -> None:
+        """Chunked admission: claim the slot (longest cached prefix copied
+        in) and queue the prompt for chunk-by-chunk prefill — the first
+        token is sampled by the FINAL chunk (``_promote``), so TTFT keeps
+        the arrival→first-token meaning, queue AND chunk wait included."""
+        kv, tracer = self.kv, self.tracer
+        lp = self._check_capacity(req)
+        req_span = tracer.span("request", rid=req.rid, prompt_len=lp,
+                               max_new_tokens=req.max_new_tokens)
+        req_span.__enter__()
+        slot, reused = kv.begin_insert(req.prompt)
+        pending[slot] = {"req": req, "span": req_span, "lp": lp,
+                         "admitted_s": self.clock.now(), "reused": reused}
+
+    def _promote(self, slot: int, pend: dict, first: int,
+                 live: dict[int, _Live]) -> None:
+        """Final chunk done: the slot joins the decode table."""
+        req = pend["req"]
+        now = self.clock.now()
+        result = RequestResult(
+            rid=req.rid, prompt_len=pend["lp"], tokens=[first],
+            arrival_s=req.arrival_s, admitted_s=pend["admitted_s"],
+            first_token_s=now)
+        dec_span = self.tracer.span("decode", rid=req.rid, slot=slot)
+        dec_span.__enter__()
+        live[slot] = _Live(req, result, pend["span"], dec_span, now)
+        if self._finished(live[slot]):
+            self._finish(slot, live)
 
     def _finished(self, lv: _Live) -> bool:
         if len(lv.result.tokens) >= lv.req.max_new_tokens:
@@ -251,37 +352,80 @@ class ContinuousBatcher:
         self.kv.evict(slot)
         self._results.append(lv.result)
 
+    def _idle_wait(self, queue: RequestQueue, target: float) -> None:
+        """Wait for the next arrival in bounded poll slices (the clock's
+        ``poll_slice_s``): each slice re-reads the queue head, so a
+        concurrent producer's earlier push is noticed within one slice and
+        an idle batcher costs a counted, bounded number of wakeups — never
+        a hot spin."""
+        clock = self.clock
+        slice_s = getattr(clock, "poll_slice_s", float("inf"))
+        while True:
+            now = clock.now()
+            nxt = queue.next_arrival()
+            if nxt is None or now >= nxt:
+                return
+            self.idle_polls += 1
+            clock.wait_until(min(nxt, now + slice_s))
+
     # ------------------------------------------------------------- the loop
     def _serve(self, queue: RequestQueue, live: dict[int, _Live],
+               pending: dict[int, dict],
                on_token: Callable[[int, int], None] | None,
-               ) -> tuple[int, int]:
+               ) -> tuple[int, int, int]:
         """The iteration loop under run()'s claim + cleanup guard; returns
-        (decode_iterations, prefills)."""
+        (decode_iterations, prefills, prefill_chunks)."""
         kv, tracer, clock = self.kv, self.tracer, self.clock
         decode_iterations = 0
         prefills = 0
-        while len(queue) or live:
+        chunks = 0
+        while len(queue) or live or pending:
             # admission between decode iterations: continuous mode
             # fills any free slot from the arrived queue; static mode
             # waits for the whole table to drain first
-            can_admit = self.mode == "continuous" or not live
+            can_admit = self.mode == "continuous" or not (live or pending)
             while can_admit and kv.free_slots:
                 req = queue.pop_ready(clock.now())
                 if req is None:
                     break
-                first = self._admit(req, live)
-                prefills += 1
-                if on_token is not None:
-                    on_token(req.rid, first)  # the prefill's own token
+                if self.prefill_chunk:
+                    self._begin_admit(req, pending)
+                else:
+                    first = self._admit(req, live)
+                    prefills += 1
+                    if on_token is not None:
+                        on_token(req.rid, first)  # the prefill's own token
+            # at most ONE ≤budget-token chunk rides each iteration: the
+            # decode stall a filling prompt can inflict is bounded by the
+            # chunk budget, whatever the prompt length
+            if pending:
+                slot = next(iter(pending))    # FIFO admission order
+                pend = pending[slot]
+                n = min(kv.pending_tokens(slot), self.prefill_chunk)
+                with tracer.span("prefill_chunk", rid=pend["req"].rid,
+                                 slot=slot, tokens=n,
+                                 start=int(kv.lengths[slot])):
+                    first = kv.prefill_chunk(slot, self.prefill_chunk)
+                chunks += 1
+                clock.on_prefill(n)
+                if first is not None:
+                    pending.pop(slot)
+                    prefills += 1
+                    self._promote(slot, pend, first, live)
+                    if on_token is not None:
+                        on_token(pend["req"].rid, first)
             if not live:
+                if pending:
+                    continue   # keep chunking: nothing to decode yet
                 nxt = queue.next_arrival()
                 if nxt is None:
                     break
-                clock.wait_until(nxt)  # idle: jump/sleep to the arrival
+                self._idle_wait(queue, nxt)  # bounded-slice sleep/jump
                 continue
             with tracer.span("decode_step", active=len(live)):
                 toks = kv.advance()
             decode_iterations += 1
+            self._decode_tokens += len(live)
             clock.on_decode_iteration()
             now = clock.now()
             for slot in sorted(live):
@@ -294,7 +438,7 @@ class ContinuousBatcher:
                     on_token(lv.req.rid, tok)
                 if self._finished(lv):
                     self._finish(slot, live)
-        return decode_iterations, prefills
+        return decode_iterations, prefills, chunks
 
     def run(self, requests: Iterable[Request] | RequestQueue,
             on_token: Callable[[int, int], None] | None = None,
@@ -305,31 +449,62 @@ class ContinuousBatcher:
         queue = (requests if isinstance(requests, RequestQueue)
                  else RequestQueue(requests))
         self._results: list[RequestResult] = []
+        self._decode_tokens = 0
+        self.idle_polls = 0
         live: dict[int, _Live] = {}
+        pending: dict[int, dict] = {}
+        prefix_before = self.kv.prefix_cache_stats()
+        prefill_before = self.kv.prefill_tokens_computed
         with queue.claim():
             self.clock.start()
             t_start = self.clock.now()
             try:
-                decode_iterations, prefills = self._serve(queue, live,
-                                                          on_token)
+                decode_iterations, prefills, chunks = self._serve(
+                    queue, live, pending, on_token)
             except BaseException:
                 # a failed window must not poison the slot table — bench
                 # windows share ONE SlotKVCache, and a leaked active slot
                 # shrinks every later window's capacity (zero free slots
                 # + zero live = a busy-spin).  Free the in-flight slots
-                # and close their spans so the records written so far
-                # survive into the partial-results artifact.
+                # (decoding AND mid-prefill) and close their spans so the
+                # records written so far survive into the partial-results
+                # artifact.
                 for slot in sorted(live):
                     lv = live.pop(slot)
                     lv.dec_span.__exit__(None, None, None)
                     lv.req_span.__exit__(None, None, None)
                     self.kv.evict(slot)
+                for slot in sorted(pending):
+                    pend = pending.pop(slot)
+                    pend["span"].__exit__(None, None, None)
+                    # a failure between the FINAL chunk and promotion
+                    # leaves the slot pending HERE but already active in
+                    # the kv (its kv-side pending entry is gone) —
+                    # release whichever state it reached; aborting an
+                    # activated slot would raise over the original error
+                    if self.kv.has_pending(slot):
+                        self.kv.abort_insert(slot)
+                    elif self.kv.active[slot]:
+                        self.kv.evict(slot)
                 raise
             elapsed = self.clock.now() - t_start
         results = sorted(self._results, key=lambda r: r.rid)
         ttfts = [r.ttft_s for r in results]
         itls = [g for r in results for g in r.itl_s]
         tokens = sum(len(r.tokens) for r in results)
+        # prefill/decode token split + prefix-pool accounting, as deltas
+        # over this run (bench windows share one SlotKVCache)
+        prefill_tokens = self.kv.prefill_tokens_computed - prefill_before
+        prefix_after = self.kv.prefix_cache_stats()
+        prefix_sec = hit_rate = None
+        if prefix_after is not None:
+            prefix_sec = {
+                k: prefix_after[k] - (prefix_before or {}).get(k, 0)
+                for k in ("hits", "misses", "evictions", "tokens_reused",
+                          "inserted_blocks")}
+            prefix_sec["cached_blocks"] = prefix_after["cached_blocks"]
+            asked = prefix_sec["hits"] + prefix_sec["misses"]
+            hit_rate = prefix_sec["hits"] / asked if asked else 0.0
         return {
             "mode": self.mode,
             "requests": len(results),
@@ -339,12 +514,27 @@ class ContinuousBatcher:
             "serve_kv_dtype": getattr(self.kv, "kv_dtype", None),
             "decode_iterations": decode_iterations,
             "prefills": prefills,
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_chunks": chunks,
+            "prefill_tokens": prefill_tokens,
+            "decode_tokens": self._decode_tokens,
+            "idle_polls": self.idle_polls,
             "tokens_generated": tokens,
             "elapsed_s": elapsed,
             "serve_requests_per_sec": (len(results) / elapsed
                                        if elapsed > 0 else None),
             "serve_tokens_per_sec": (tokens / elapsed
                                      if elapsed > 0 else None),
+            # the split the chunked-prefill trade is tuned by: prompt
+            # tokens prefilled vs tokens decoded, per wall/virtual second
+            "serve_prefill_tokens_per_sec": (prefill_tokens / elapsed
+                                             if elapsed > 0 else None),
+            "serve_decode_tokens_per_sec": (self._decode_tokens / elapsed
+                                            if elapsed > 0 else None),
+            # block-level prefix-pool hit rate for THIS run (None: pool
+            # off) + the hit/miss/evict ledger behind it
+            "serve_prefix_cache_hit_rate": hit_rate,
+            "prefix_cache": prefix_sec,
             "serve_ttft_p50_s": _percentile(ttfts, 0.50),
             "serve_ttft_p95_s": _percentile(ttfts, 0.95),
             "serve_itl_p50_s": _percentile(itls, 0.50),

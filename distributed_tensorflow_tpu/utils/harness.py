@@ -232,6 +232,28 @@ class ExperimentConfig:
                                            # ('bfloat16' halves KV memory →
                                            # double the slots per chip);
                                            # None: the model's dtype
+    serve_prefill_chunk: int = 0           # >0: chunked prefill token
+                                           # budget (Sarathi-Serve) — at
+                                           # most one ≤N-token prompt chunk
+                                           # rides each decode iteration,
+                                           # so a long admission cannot
+                                           # stall live slots for more
+                                           # than a chunk; 0 = monolithic
+                                           # (pre-round-10 programs)
+    serve_prefix_cache: int = 0            # >0: prefix-cache pool capacity
+                                           # in KV blocks (vLLM-style
+                                           # block reuse; LRU past the
+                                           # bound); admission copies the
+                                           # longest cached prompt prefix
+                                           # into the slot and prefills
+                                           # only the uncached tail
+    serve_prefix_block: int = 16           # tokens per prefix-cache block
+                                           # (reuse granularity)
+    serve_shared_prefix: int = 0           # >0: prepend a fixed synthetic
+                                           # N-token system prompt to
+                                           # every request (the shared-
+                                           # prefix traffic shape;
+                                           # deterministic from seed)
 
 
 def enable_compile_cache(directory: str | os.PathLike) -> str:
@@ -1911,13 +1933,31 @@ def _validate_serving(config: ExperimentConfig, ex: _Experiment,
         raise ValueError(
             f"--serve requires the GPT causal LM; the resolved model is "
             f"{type(model).__name__}")
+    if config.serve_prefill_chunk < 0:
+        raise ValueError(
+            f"--serve-prefill-chunk must be >= 0 (0 = monolithic "
+            f"prefill), got {config.serve_prefill_chunk}")
+    if config.serve_prefix_cache < 0:
+        raise ValueError(
+            f"--serve-prefix-cache must be >= 0 (0 = off), got "
+            f"{config.serve_prefix_cache}")
+    if config.serve_prefix_block < 1:
+        raise ValueError(
+            f"--serve-prefix-block must be positive, got "
+            f"{config.serve_prefix_block}")
+    if config.serve_shared_prefix < 0:
+        raise ValueError(
+            f"--serve-shared-prefix must be >= 0, got "
+            f"{config.serve_shared_prefix}")
     plen = config.serve_prompt_len
     if plen < 1 or plen > test_ds.x.shape[1]:
         raise ValueError(
             f"--serve-prompt-len {plen} outside the test sequences' "
             f"length {test_ds.x.shape[1]}")
-    if plen + config.serve_max_new > model.max_len:
+    total_prompt = plen + config.serve_shared_prefix
+    if total_prompt + config.serve_max_new > model.max_len:
         raise ValueError(
+            f"--serve-shared-prefix {config.serve_shared_prefix} + "
             f"--serve-prompt-len {plen} + --serve-max-new "
             f"{config.serve_max_new} exceeds the model's capacity "
             f"max_len={model.max_len}")
@@ -1964,16 +2004,30 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
         # model's compute dtype via promotion
         kv_dtype = modellib.resolve_dtype(config.serve_kv_dtype)
     kv = SlotKVCache(ex.engine.model, params, config.serve_slots,
-                     mesh=mesh, kv_dtype=kv_dtype)
+                     mesh=mesh, kv_dtype=kv_dtype,
+                     prefix_cache_blocks=config.serve_prefix_cache,
+                     prefix_block=config.serve_prefix_block)
     rows = np.asarray(test_ds.x, np.int32)
     plen = config.serve_prompt_len
+    # --serve-shared-prefix: a fixed synthetic system prompt every request
+    # shares (deterministic from the run seed) — the traffic shape the
+    # prefix pool exists for; with the pool on, every admission after the
+    # first reuses the shared blocks instead of recomputing them
+    shared = np.zeros(0, np.int32)
+    if config.serve_shared_prefix:
+        vocab = int(ex.engine.model.vocab_size)
+        shared = np.random.default_rng(config.seed).integers(
+            0, vocab, config.serve_shared_prefix).astype(np.int32)
     requests = [
-        Request(rid=i, prompt=rows[i % len(rows), :plen],
+        Request(rid=i,
+                prompt=np.concatenate([shared, rows[i % len(rows), :plen]]),
                 max_new_tokens=config.serve_max_new, arrival_s=0.0)
         for i in range(config.serve_requests)]
     with tracer.span("serve", requests=config.serve_requests,
                      slots=config.serve_slots):
-        summary = ContinuousBatcher(kv, tracer=tracer).run(requests)
+        summary = ContinuousBatcher(
+            kv, tracer=tracer,
+            prefill_chunk=config.serve_prefill_chunk).run(requests)
     return serve_section(summary, total_devices)
 
 

@@ -125,7 +125,10 @@ def test_slot_decode_matches_generate_per_slot(model_params):
 
 def test_insert_never_recompiles_decode(model_params):
     """The recompile-freedom invariant: admissions compile one prefill per
-    padded-length bucket and the decode step exactly once."""
+    padded-length bucket and the decode step exactly once — and with
+    chunking and the prefix pool OFF, the chunk/block program families are
+    EMPTY: the compiled set is exactly the PR 7 one (the acceptance pin
+    for `--serve-prefill-chunk 0` + cache off)."""
     model, params = model_params
     kv = SlotKVCache(model, params, slots=2, prefill_bucket=4)
     kv.insert(np.arange(3, dtype=np.int32))         # bucket 4
@@ -135,7 +138,44 @@ def test_insert_never_recompiles_decode(model_params):
     kv.insert(np.arange(7, dtype=np.int32) % 64)    # bucket 8
     kv.advance()
     assert kv.compiled_programs() == {"decode_steps": 1,
-                                      "prefill_buckets": 2}
+                                      "prefill_buckets": 2,
+                                      "prefill_chunk_buckets": 0,
+                                      "prefix_block_ops": 0}
+
+
+def test_chunked_prefill_programs_bucketed(model_params):
+    """Chunk programs compile once per power-of-two CHUNK bucket — a
+    budget-4 admission of any prompt length reuses {4, 2, 1} buckets and
+    never touches the monolithic prefill family or the decode step."""
+    model, params = model_params
+    kv = SlotKVCache(model, params, slots=2)
+    slot, _ = kv.begin_insert(np.arange(11, dtype=np.int32) % 64)
+    seen = []
+    while True:
+        first = kv.prefill_chunk(slot, 4)
+        seen.append(first)
+        if first is not None:
+            break
+    assert seen[-1] is not None and all(s is None for s in seen[:-1])
+    assert len(seen) == 3                           # 4 + 4 + 3 tokens
+    kv.advance()
+    # second admission at the same budget: no new programs (full chunks
+    # pad to bucket 4, the 3-token tails bucket to 4 as well)
+    slot2, _ = kv.begin_insert(np.arange(7, dtype=np.int32) % 64)
+    while kv.prefill_chunk(slot2, 4) is None:
+        pass
+    progs = kv.compiled_programs()
+    assert progs["decode_steps"] == 1
+    assert progs["prefill_buckets"] == 0
+    assert progs["prefill_chunk_buckets"] == 1
+    assert progs["prefix_block_ops"] == 0
+    # a 1-token tail (prompt 5 = 4 + 1) adds exactly the bucket-1 program
+    kv.advance()
+    kv.evict(slot)
+    slot3, _ = kv.begin_insert(np.arange(5, dtype=np.int32) % 64)
+    while kv.prefill_chunk(slot3, 4) is None:
+        pass
+    assert kv.compiled_programs()["prefill_chunk_buckets"] == 2
 
 
 def test_slot_overflow_guard(model_params):
@@ -352,6 +392,382 @@ def test_scheduler_emits_request_spans(model_params, tmp_path):
     assert spans["decode_step"]["count"] >= 1
 
 
+# ---------------------------------------- chunked prefill + prefix caching
+
+
+@pytest.mark.parametrize("budget", [2, 4])
+def test_chunked_run_matches_generate(model_params, budget):
+    """Chunked prefill is bitwise: the same staggered workload as the
+    monolithic e2e test, greedy tokens identical to the sequential
+    ``generate`` oracle at every chunk budget."""
+    model, params = model_params
+    prompts = _prompts(5, seed=4)
+    news = [6, 3, 8, 2, 5]
+    arrivals = [0.0, 0.0, 1.0, 4.0, 6.0]
+    kv = SlotKVCache(model, params, slots=2)
+    res = ContinuousBatcher(kv, clock=VirtualClock(),
+                            prefill_chunk=budget).run(
+        [Request(rid=i, prompt=p, max_new_tokens=news[i],
+                 arrival_s=arrivals[i]) for i, p in enumerate(prompts)])
+    assert res["completed"] == 5
+    assert res["prefill_chunk"] == budget
+    assert res["prefill_chunks"] > 5     # at least one prompt needed >1
+    for i, p in enumerate(prompts):
+        np.testing.assert_array_equal(
+            _oracle(model, params, p, news[i]),
+            np.asarray(res["results"][i].tokens), str(i))
+    assert kv.free_slots == [0, 1]
+
+
+def test_chunked_prefill_bounds_decode_stall(model_params):
+    """THE round-10 chunked-prefill acceptance claim, deterministic in
+    decode-iteration time: one near-max-length prompt admitted into a
+    table of live short requests stalls each live slot by at most one
+    chunk per decode iteration (worst inter-token gap ≤ tick +
+    budget × prefill_token_tick), strictly smaller than the monolithic
+    admission's whole-prompt stall on the same seeded trace — with
+    identical greedy tokens in both modes."""
+    model, params = model_params
+    rng = np.random.default_rng(9)
+    short = [rng.integers(0, 64, 4).astype(np.int32) for _ in range(2)]
+    long_p = rng.integers(0, 64, 24).astype(np.int32)
+
+    def reqs():
+        rs = [Request(rid=i, prompt=p, max_new_tokens=7, arrival_s=0.0)
+              for i, p in enumerate(short)]
+        rs.append(Request(rid=2, prompt=long_p, max_new_tokens=4,
+                          arrival_s=2.0))
+        return rs
+
+    C, budget = 0.25, 4
+    out = {}
+    for b in (0, budget):
+        kv = SlotKVCache(model, params, slots=3)
+        res = ContinuousBatcher(
+            kv, clock=VirtualClock(prefill_token_tick=C),
+            prefill_chunk=b).run(reqs())
+        worst = max(g for r in res["results"][:2] for g in r.itl_s)
+        out[b] = (worst, [r.tokens for r in res["results"]])
+    chunk_worst, chunk_toks = out[budget]
+    mono_worst, mono_toks = out[0]
+    assert chunk_worst <= 1.0 + budget * C + 1e-9, chunk_worst
+    assert mono_worst >= 1.0 + len(long_p) * C - 1e-9, mono_worst
+    assert chunk_worst < mono_worst
+    assert chunk_toks == mono_toks    # greedy tokens identical
+
+
+def test_prefix_cache_hit_bitwise_parity(model_params):
+    """Shared-prefix prompts served through the prefix pool produce
+    bitwise-identical greedy tokens to the no-cache sequential oracle,
+    and the pool reports hits for every request after the first."""
+    model, params = model_params
+    rng = np.random.default_rng(11)
+    shared = rng.integers(0, 64, 10).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rng.integers(0, 64, 4).astype(np.int32)])
+               for _ in range(4)]
+    kv = SlotKVCache(model, params, slots=2, prefix_cache_blocks=32,
+                     prefix_block=4)
+    res = ContinuousBatcher(kv, clock=VirtualClock()).run(
+        [Request(rid=i, prompt=p, max_new_tokens=5, arrival_s=0.0)
+         for i, p in enumerate(prompts)])
+    for i, p in enumerate(prompts):
+        np.testing.assert_array_equal(
+            _oracle(model, params, p, 5),
+            np.asarray(res["results"][i].tokens), str(i))
+    assert res["serve_prefix_cache_hit_rate"] > 0
+    pc = res["prefix_cache"]
+    # the 10-token shared prefix spans blocks 0 and 1; requests 2-4 each
+    # reuse both (block 2 mixes shared and per-request tokens)
+    assert pc["hits"] == 6 and pc["tokens_reused"] == 24
+    assert pc["evictions"] == 0
+    # the reused tokens were NOT recomputed
+    assert res["prefill_tokens"] == sum(len(p) for p in prompts) - 24
+
+
+def test_prefix_cache_composes_with_chunked_prefill(model_params):
+    """Chunk + pool together: prefill resumes at the first uncached block
+    AND fills in budget-sized chunks — still bitwise vs the oracle."""
+    model, params = model_params
+    rng = np.random.default_rng(12)
+    shared = rng.integers(0, 64, 8).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rng.integers(0, 64, 5).astype(np.int32)])
+               for _ in range(3)]
+    kv = SlotKVCache(model, params, slots=2, prefix_cache_blocks=16,
+                     prefix_block=4)
+    res = ContinuousBatcher(kv, clock=VirtualClock(),
+                            prefill_chunk=3).run(
+        [Request(rid=i, prompt=p, max_new_tokens=4, arrival_s=float(i))
+         for i, p in enumerate(prompts)])
+    for i, p in enumerate(prompts):
+        np.testing.assert_array_equal(
+            _oracle(model, params, p, 4),
+            np.asarray(res["results"][i].tokens), str(i))
+    assert res["serve_prefix_cache_hit_rate"] > 0
+
+
+def test_prefix_cache_lru_eviction_and_pool_full(model_params):
+    """A bounded pool evicts least-recently-used blocks and keeps
+    admitting correctly: capacity 2 blocks across three distinct
+    prompts forces evictions; every admission still completes with
+    oracle-exact tokens, and a re-admission of an evicted prefix misses
+    then re-pools."""
+    model, params = model_params
+    prompts = _prompts(3, seed=13, lo=9, hi=10)   # 9 tokens = 2 blocks ea
+    kv = SlotKVCache(model, params, slots=1, prefix_cache_blocks=2,
+                     prefix_block=4)
+
+    def admit(p):
+        slot, first = kv.insert(p)
+        got = [first]
+        for _ in range(2):
+            got.append(int(kv.advance()[slot]))
+        kv.evict(slot)
+        np.testing.assert_array_equal(_oracle(model, params, p, 3),
+                                      np.asarray(got))
+
+    for p in prompts:
+        admit(p)
+    stats = kv.prefix_cache_stats()
+    assert stats["evictions"] >= 2           # 3×2 blocks through a 2-pool
+    assert stats["cached_blocks"] <= 2
+    hits_before = stats["hits"]
+    admit(prompts[0])                        # evicted prefix: full miss
+    assert kv.prefix_cache_stats()["hits"] == hits_before
+    admit(prompts[0])                        # freshly re-pooled: hits
+    assert kv.prefix_cache_stats()["hits"] > hits_before
+    kv.reset_prefix_cache()
+    assert kv.prefix_cache_stats()["hits"] == 0
+    assert kv.prefix_cache_stats()["cached_blocks"] == 0
+
+
+def test_prefix_cache_lowers_virtual_ttft(model_params):
+    """The TTFT acceptance claim on the deterministic clock: with prefill
+    cost modeled (prefill_token_tick > 0), the cached run's TTFT p50 is
+    LOWER than the cache-off run on the same trace — reused blocks are
+    prefill work that never happens."""
+    model, params = model_params
+    rng = np.random.default_rng(14)
+    shared = rng.integers(0, 64, 12).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rng.integers(0, 64, 3).astype(np.int32)])
+               for _ in range(4)]
+
+    def run(blocks):
+        kv = SlotKVCache(model, params, slots=2,
+                         prefix_cache_blocks=blocks, prefix_block=4)
+        return ContinuousBatcher(
+            kv, clock=VirtualClock(prefill_token_tick=0.5)).run(
+            [Request(rid=i, prompt=p, max_new_tokens=4,
+                     arrival_s=float(i)) for i, p in enumerate(prompts)])
+
+    cached, cold = run(32), run(0)
+    assert cached["serve_prefix_cache_hit_rate"] > 0
+    assert cold["serve_prefix_cache_hit_rate"] is None
+    assert cached["serve_ttft_p50_s"] < cold["serve_ttft_p50_s"]
+    for i in range(len(prompts)):
+        np.testing.assert_array_equal(
+            np.asarray(cached["results"][i].tokens),
+            np.asarray(cold["results"][i].tokens), str(i))
+
+
+def test_chunked_prefix_cache_on_mesh(model_params, mesh8):
+    """Chunk-resumable prefill + the prefix pool on a slot-sharded table
+    (8-way data axis): pooled blocks replicate, hits restore into ANY
+    slot, and staggered-age slots still match the sequential oracle."""
+    model, params = model_params
+    rng = np.random.default_rng(21)
+    shared = rng.integers(0, 64, 8).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rng.integers(0, 64, 3).astype(np.int32)])
+               for _ in range(4)]
+    kv = SlotKVCache(model, params, slots=8, mesh=mesh8,
+                     prefix_cache_blocks=16, prefix_block=4)
+    out = {}
+    for p in prompts:           # sequential admissions: the pool warms
+        slot, _ = kv.begin_insert(p)
+        while True:
+            first = kv.prefill_chunk(slot, 4)
+            if first is not None:
+                break
+        out[slot] = (p, [first])
+        toks = kv.advance()
+        for s, (_, got) in out.items():
+            got.append(int(toks[s]))
+    for _ in range(2):
+        toks = kv.advance()
+        for s, (_, got) in out.items():
+            got.append(int(toks[s]))
+    for s, (p, got) in out.items():
+        np.testing.assert_array_equal(
+            _oracle(model, params, p, len(got)), np.asarray(got))
+    stats = kv.prefix_cache_stats()
+    assert stats["hits"] >= 6   # blocks 0-1 shared by requests 2-4
+    leaf = jax.tree.leaves(kv.cache)[0]
+    from distributed_tensorflow_tpu.parallel import mesh as meshlib
+    assert leaf.sharding.spec[0] == meshlib.DATA_AXIS
+
+
+def test_run_failure_frees_pending_chunked_slots(model_params):
+    """A window dying MID-CHUNKED-PREFILL must release reserved slots and
+    close their request spans (the PR 7 cleanup guard extended to the
+    pending table): the same cache serves the next window cleanly."""
+    from distributed_tensorflow_tpu.observability import Tracer
+    from distributed_tensorflow_tpu.observability.analyze import (
+        read_jsonl, trace_summary)
+
+    model, params = model_params
+    kv = SlotKVCache(model, params, slots=2)
+
+    class Boom(RuntimeError):
+        pass
+
+    class BoomClock(VirtualClock):
+        def on_prefill(self, tokens):
+            raise Boom("chunk died")
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        path = f"{td}/t.jsonl"
+        tracer = Tracer(path=path)
+        reqs = [Request(rid=0, prompt=_prompts(1, seed=8, lo=8, hi=9)[0],
+                        max_new_tokens=3, arrival_s=0.0)]
+        with pytest.raises(Boom):
+            ContinuousBatcher(kv, tracer=tracer, clock=BoomClock(),
+                              prefill_chunk=2).run(reqs)
+        tracer.close()
+        assert kv.free_slots == [0, 1]
+        assert not kv._pending
+        spans = trace_summary(read_jsonl(path))["spans"]
+        assert spans["request"]["count"] == 1
+    res = ContinuousBatcher(kv, clock=VirtualClock()).run(
+        [Request(rid=1, prompt=_prompts(1, seed=9)[0], max_new_tokens=2,
+                 arrival_s=0.0)])
+    assert res["completed"] == 1
+
+
+def test_run_failure_after_final_chunk_releases_activated_slot(
+        model_params):
+    """A failure landing BETWEEN the final chunk (which activates the
+    slot in the kv) and the scheduler's promotion must surface the
+    ORIGINAL error — not an abort-of-nothing-pending RuntimeError — and
+    must release the activated slot (regression: the cleanup called
+    abort_insert unconditionally, masking the error and leaking the slot
+    active forever)."""
+    model, params = model_params
+    kv = SlotKVCache(model, params, slots=2)
+
+    class Boom(RuntimeError):
+        pass
+
+    class BoomClock(VirtualClock):
+        def on_prefill(self, tokens):
+            raise Boom("after final chunk")
+
+    # 3-token prompt ≤ budget 4: the FIRST chunk is the final one
+    with pytest.raises(Boom, match="after final chunk"):
+        ContinuousBatcher(kv, clock=BoomClock(), prefill_chunk=4).run(
+            [Request(rid=0, prompt=np.arange(3, dtype=np.int32),
+                     max_new_tokens=3, arrival_s=0.0)])
+    assert kv.free_slots == [0, 1]
+    assert not kv._pending
+    res = ContinuousBatcher(kv, clock=VirtualClock()).run(
+        [Request(rid=0, prompt=np.arange(3, dtype=np.int32),
+                 max_new_tokens=2, arrival_s=0.0)])
+    assert res["completed"] == 1
+
+
+def test_insert_failure_after_activation_releases_slot(model_params):
+    """insert() with the pool on: a failure AFTER the final chunk
+    activated the slot (e.g. inside the pool-extraction step) must
+    re-raise the original error and leave the slot evicted, not raise
+    'no pending admission' over it."""
+    model, params = model_params
+    kv = SlotKVCache(model, params, slots=1, prefix_cache_blocks=4,
+                     prefix_block=2)
+
+    class Boom(RuntimeError):
+        pass
+
+    def boom_pool(prompt, lp, slot):
+        raise Boom("pool extraction died")
+
+    kv._pool_prefix = boom_pool
+    with pytest.raises(Boom, match="pool extraction died"):
+        kv.insert(np.arange(5, dtype=np.int32))
+    assert kv.free_slots == [0]
+    assert not kv._pending
+
+
+def test_serve_summary_token_split(model_params):
+    """prefill/decode token accounting: prefill_tokens counts prompt
+    tokens actually computed, decode_tokens the advance-emitted tokens
+    (every request's FIRST token is prefill-side), and the *_per_sec
+    split divides by the same elapsed as the other rates."""
+    model, params = model_params
+    prompts = _prompts(3, seed=15)
+    kv = SlotKVCache(model, params, slots=2)
+    res = ContinuousBatcher(kv, clock=VirtualClock()).run(
+        [Request(rid=i, prompt=p, max_new_tokens=4, arrival_s=0.0)
+         for i, p in enumerate(prompts)])
+    assert res["prefill_tokens"] == sum(len(p) for p in prompts)
+    assert res["decode_tokens"] == res["tokens_generated"] - 3
+    assert res["serve_prefill_tokens_per_sec"] == pytest.approx(
+        res["prefill_tokens"] / res["elapsed_s"])
+    assert res["serve_decode_tokens_per_sec"] == pytest.approx(
+        res["decode_tokens"] / res["elapsed_s"])
+    assert res["serve_prefix_cache_hit_rate"] is None  # pool off
+    assert res["prefix_cache"] is None
+
+
+# ------------------------------------------------- queue backoff / idle
+
+
+def test_queue_claim_bounded_backoff():
+    """The busy-claim loop is BOUNDED: a claim against a busy queue
+    retries with short backoff sleeps a fixed number of times (attempt
+    count recorded), then raises — never a hot spin, never unbounded."""
+    import time as _time
+
+    q = RequestQueue()
+    with q.claim():
+        assert q.claim_attempts == 1
+        t0 = _time.monotonic()
+        with pytest.raises(RuntimeError, match="bounded claim attempts"):
+            with q.claim(max_attempts=4, backoff_s=0.001):
+                pass
+        elapsed = _time.monotonic() - t0
+        assert q.claim_attempts == 4
+        assert elapsed < 1.0          # 3 sleeps of ≤8 ms: bounded cost
+    with q.claim():                   # released deterministically
+        pass
+
+
+def test_idle_wait_bounded_polls(model_params):
+    """An idle batcher waiting for the next arrival wakes a bounded,
+    counted number of times (poll slices), not once per loop spin: the
+    wait to a far-future arrival under a sliced clock performs
+    ~wait/slice polls, and the VirtualClock (slice = ∞) exactly one."""
+    model, params = model_params
+
+    class SlicedClock(VirtualClock):
+        poll_slice_s = 2.0
+
+    kv = SlotKVCache(model, params, slots=1)
+    b = ContinuousBatcher(kv, clock=SlicedClock())
+    res = b.run([Request(rid=0, prompt=np.arange(3, dtype=np.int32),
+                         max_new_tokens=2, arrival_s=9.0)])
+    assert res["completed"] == 1
+    # 9.0 of idle in 2.0-slices: 5 polls (the last lands on the arrival)
+    assert res["idle_polls"] == 5
+    kv2 = SlotKVCache(model, params, slots=1)
+    res2 = ContinuousBatcher(kv2, clock=VirtualClock()).run(
+        [Request(rid=0, prompt=np.arange(3, dtype=np.int32),
+                 max_new_tokens=2, arrival_s=9.0)])
+    assert res2["idle_polls"] == 1    # jump straight to the arrival
+
+
 # ------------------------------------------------ observability vocabulary
 
 
@@ -362,13 +778,22 @@ def test_analyze_diff_serve_directions():
     from distributed_tensorflow_tpu.observability.analyze import diff_reports
 
     base = {"serve_ttft_p95_s": 1.0, "serve_itl_p95_s": 0.1,
-            "serve_requests_per_sec_per_chip": 10.0}
+            "serve_requests_per_sec_per_chip": 10.0,
+            "serve_prefix_cache_hit_rate": 0.8,
+            "serve_prefill_tokens_per_sec": 100.0,
+            "serve_decode_tokens_per_sec": 200.0}
     worse = {"serve_ttft_p95_s": 2.0, "serve_itl_p95_s": 0.3,
-             "serve_requests_per_sec_per_chip": 5.0}
+             "serve_requests_per_sec_per_chip": 5.0,
+             "serve_prefix_cache_hit_rate": 0.2,
+             "serve_prefill_tokens_per_sec": 50.0,
+             "serve_decode_tokens_per_sec": 100.0}
     d = diff_reports(base, worse, threshold=0.1)
     regressed = {r["metric"] for r in d["regressions"]}
     assert regressed == {"serve_ttft_p95_s", "serve_itl_p95_s",
-                         "serve_requests_per_sec_per_chip"}
+                         "serve_requests_per_sec_per_chip",
+                         "serve_prefix_cache_hit_rate",
+                         "serve_prefill_tokens_per_sec",
+                         "serve_decode_tokens_per_sec"}
     better = diff_reports(worse, base, threshold=0.1)
     assert not better["regressions"]
     assert {r["metric"] for r in better["improvements"]} == regressed
@@ -392,6 +817,35 @@ def test_analyze_value_direction_rates_are_higher_better():
     assert _value_direction(
         {"metric": "some_latency_probe", "unit": "seconds_per_step"}) \
         == "lower"
+    # round-10 keys: the prefill/decode split and the hit rate are rates
+    # — each new *_per_sec key must resolve higher-better (the `sec_per`
+    # substring bug class this test pins)
+    assert _value_direction(
+        {"metric": "gpt_serve_prefill_tokens_per_sec",
+         "unit": "tokens/sec"}) == "higher"
+    assert _value_direction(
+        {"metric": "gpt_serve_decode_tokens_per_sec",
+         "unit": "tokens/sec"}) == "higher"
+
+
+def test_load_report_flattens_round10_serve_keys(tmp_path):
+    """The new serve keys flatten out of a run report's nested serve
+    section and diff with the standard machinery."""
+    from distributed_tensorflow_tpu.observability.analyze import (
+        diff_reports, load_report)
+
+    summary = {"steps": 2, "run_report": {
+        "serve": {"serve_prefix_cache_hit_rate": 0.75,
+                  "serve_prefill_tokens_per_sec": 120.0,
+                  "serve_decode_tokens_per_sec": 300.0}}}
+    p = tmp_path / "summary.json"
+    p.write_text(json.dumps(summary))
+    flat = load_report(p)
+    assert flat["serve_prefix_cache_hit_rate"] == 0.75
+    worse = dict(flat, serve_prefix_cache_hit_rate=0.1)
+    d = diff_reports(flat, worse)
+    assert [r["metric"] for r in d["regressions"]] == \
+        ["serve_prefix_cache_hit_rate"]
 
 
 def test_load_report_flattens_serve_section(tmp_path):
@@ -558,6 +1012,64 @@ def test_harness_serve_e2e_fsdp():
     assert sec["tokens_generated"] == 40
 
 
+def test_harness_serve_chunked_prefix_e2e():
+    """--serve-prefill-chunk + --serve-prefix-cache + --serve-shared-prefix
+    thread through the harness: the serve section carries the token split,
+    a nonzero hit rate (every request shares the synthetic system prompt)
+    and the chunk accounting, in summary AND run report."""
+    from distributed_tensorflow_tpu.data.loaders import load_lm_dataset
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    def lm_fn(batch_size, type="train", **kw):
+        return load_lm_dataset(seq_len=16, vocab_size=64, n_train=64,
+                               n_test=32, split=type)
+
+    summary = run(ExperimentConfig(
+        engine="fsdp", model="gpt", dataset="lm_synth", dataset_fn=lm_fn,
+        n_devices=8, batch_size=4, log_every=0,
+        model_args={"hidden": 32, "layers": 1, "heads": 2, "ffn": 64,
+                    "max_len": 32},
+        # 2 slots for 6 requests: later admissions arrive after earlier
+        # prefills pooled the shared blocks (with slots ≥ requests the
+        # whole burst admits cold — pooling happens at prefill
+        # completion, so a simultaneous burst cannot share)
+        serve_requests=6, serve_slots=2, serve_max_new=4,
+        serve_prompt_len=4, serve_prefill_chunk=4, serve_prefix_cache=16,
+        serve_prefix_block=4, serve_shared_prefix=6))
+    sec = summary["serve"]
+    assert sec == summary["run_report"]["serve"]
+    assert sec["completed"] == 6
+    assert sec["prefill_chunk"] == 4
+    assert sec["prefill_chunks"] >= 6
+    assert sec["serve_prefix_cache_hit_rate"] > 0
+    assert sec["prefix_cache"]["hits"] > 0
+    assert sec["serve_prefill_tokens_per_sec"] > 0
+    assert sec["serve_decode_tokens_per_sec"] > 0
+    # shared prefix rides every prompt: 6 + 4 tokens each, minus reuse
+    assert sec["prefill_tokens"] < 6 * 10
+
+
+def test_harness_serve_validation_round10_flags():
+    """Bad chunk/pool/shared-prefix flags fail BEFORE training, like every
+    other deterministically-knowable --serve failure."""
+    from distributed_tensorflow_tpu.utils.harness import (
+        ExperimentConfig, run)
+
+    base = dict(engine="fsdp", model="gpt", dataset="lm_synth",
+                n_devices=8, serve_requests=2,
+                model_args={"hidden": 32, "layers": 1, "heads": 2,
+                            "ffn": 64})
+    with pytest.raises(ValueError, match="serve-prefill-chunk"):
+        run(ExperimentConfig(**base, serve_prefill_chunk=-1))
+    with pytest.raises(ValueError, match="serve-prefix-cache"):
+        run(ExperimentConfig(**base, serve_prefix_cache=-1))
+    with pytest.raises(ValueError, match="serve-prefix-block"):
+        run(ExperimentConfig(**base, serve_prefix_block=0))
+    with pytest.raises(ValueError, match="max_len"):
+        run(ExperimentConfig(**base, serve_shared_prefix=1024))
+
+
 @pytest.mark.parametrize("stream", [False, True])
 def test_bench_serve_smoke_emits_json(stream):
     """`bench.py --serve` must emit ONE parsable JSON line whatever the
@@ -571,9 +1083,19 @@ def test_bench_serve_smoke_emits_json(stream):
                BENCH_SERVE_HIDDEN="32", BENCH_SERVE_LAYERS="1",
                BENCH_SERVE_HEADS="2", BENCH_SERVE_FFN="64",
                BENCH_SERVE_VOCAB="64", BENCH_SERVE_PROMPT_LEN="6",
+               # arrivals ~0.2 s apart: the subprocess may see an 8-way
+               # CPU platform (slots round 2→8), and a simultaneous burst
+               # into ≥N slots admits cold — pool hits need later
+               # requests to ARRIVE after an earlier prefill pooled the
+               # shared blocks
                BENCH_SERVE_MAX_NEW="6", BENCH_SERVE_SLOTS="2",
-               BENCH_SERVE_REQUESTS="4", BENCH_SERVE_RATE="500",
-               BENCH_SERVE_REPEATS="1")
+               BENCH_SERVE_REQUESTS="4", BENCH_SERVE_RATE="5",
+               BENCH_SERVE_REPEATS="1",
+               BENCH_SERVE_PREFILL_CHUNK="2",
+               BENCH_SERVE_PREFIX_CACHE="8",
+               BENCH_SERVE_PREFIX_BLOCK="2",
+               BENCH_SERVE_SHARED_PREFIX="4",
+               BENCH_SERVE_LONG_EVERY="2")
     cmd = [sys.executable, str(repo / "bench.py"), "--serve", "--no-probe"]
     if stream:
         cmd.append("--stream")
@@ -587,13 +1109,24 @@ def test_bench_serve_smoke_emits_json(stream):
         assert payload["value"] is None and payload["error"]
         return
     for key in ("serve_requests_per_sec_per_chip", "serve_ttft_p50_s",
-                "serve_ttft_p95_s", "serve_itl_p50_s", "serve_itl_p95_s"):
+                "serve_ttft_p95_s", "serve_itl_p50_s", "serve_itl_p95_s",
+                "serve_prefill_tokens_per_sec",
+                "serve_decode_tokens_per_sec"):
         assert payload[key] is not None and payload[key] >= 0, key
     assert payload["value"] == pytest.approx(
         payload["serve_requests_per_sec_per_chip"], rel=1e-3)
-    # the static baseline rode the same arrival trace
+    # round 10: the shared-prefix workload hits the pool, and the
+    # monolithic same-trace comparison rode the line
+    assert payload["serve_prefix_cache_hit_rate"] > 0
+    assert payload["monolithic_itl_p95_s"] is not None
+    assert payload["monolithic_ttft_p50_s"] is not None
+    assert payload["config"]["prefill_chunk"] == 2
+    assert payload["config"]["shared_prefix"] == 4
+    # the static baseline rode the same arrival trace; the iteration
+    # invariant is program-for-program (monolithic continuous vs static
+    # — the chunked window legitimately runs MORE, smaller iterations)
     assert payload["static_decode_iterations"] >= \
-        payload["serve_decode_iterations"]
+        payload["monolithic_decode_iterations"]
     assert payload["continuous_vs_static"] is not None
     assert payload["jax_version"]
     assert payload["stream"] is stream
