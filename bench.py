@@ -1108,6 +1108,7 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, vocab: int = 16384,
 
     from distributed_tensorflow_tpu.models import create_model
     from distributed_tensorflow_tpu.models.gpt import generate as gpt_generate
+    from distributed_tensorflow_tpu.observability import exact_percentile
 
     def note(msg):
         print(f"[bench --decode] {msg}", file=sys.stderr, flush=True)
@@ -1139,6 +1140,7 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, vocab: int = 16384,
              f"{time.perf_counter() - t0:.0f}s")
 
     rates = []
+    per_steps = []
     for rep in range(REPEATS):
         t = {}
         for n_new in (short, long):
@@ -1147,6 +1149,7 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, vocab: int = 16384,
             t[n_new] = time.perf_counter() - t0
         per_step = (t[long] - t[short]) / (long - short)
         rates.append(batch / per_step)
+        per_steps.append(per_step)
         note(f"rep {rep}: {rates[-1] / 1e3:.2f}k tokens/s, "
              f"{per_step * 1e3:.3f} ms/step")
     med, spread = _median_spread(rates)
@@ -1182,10 +1185,14 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, vocab: int = 16384,
         "ms_per_step": round(1e3 / steps_per_sec, 3),
         # TTFT (prompt prefill + first token, batch-wide) vs the marginal
         # per-token decode step — the split serving latency budgets are
-        # written in (BASELINE.md "Serving comparisons")
+        # written in (BASELINE.md "Serving comparisons").  p99 over the
+        # repeat samples rides along (stdlib-percentile path, the serve
+        # section convention) — the tail SLOs are written against.
         "ttft_s": round(ttft_med, 6),
         "ttft_spread": round(ttft_spread, 4),
+        "ttft_p99_s": round(exact_percentile(ttft_times, 0.99), 6),
         "per_token_s": round(1.0 / steps_per_sec, 6),
+        "per_token_p99_s": round(exact_percentile(per_steps, 0.99), 6),
         "achieved_weight_stream_GBps": round(gbps, 1),
         "params_millions": round(n_params / 1e6, 1),
         "params_bytes": params_bytes,
@@ -1209,7 +1216,9 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, vocab: int = 16384,
 # --serve: continuous-batching serving under an open-loop arrival process
 # ---------------------------------------------------------------------------
 
-def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
+def bench_serve(stream: bool = False, trace_path: str | None = None,
+                sweep: bool = False, slo_ttft: float | None = None,
+                slo_itl: float | None = None, queue_cap: int = 0) -> None:
     """Serving throughput + latency percentiles of the continuous-batching
     engine (distributed_tensorflow_tpu/serving/) against the static-batch
     restart-per-``generate`` baseline, on the SAME synthetic open-loop
@@ -1218,7 +1227,16 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
     budget, percentile accounting.
 
     TTFT/ITL are MLPerf-style latency percentiles (queue wait included in
-    TTFT); the headline is requests/sec/chip.  Round 10: the default
+    TTFT); the headline is requests/sec/chip.  Round 13: every window
+    runs under an SLOMonitor (``--serve-slo-ttft``/``--serve-slo-itl``,
+    p99 ITL per request) so the line carries p99 latency +
+    ``serve_goodput_under_slo``; ``--sweep`` turns the bench into the
+    MLPerf-style SLO load harness — the Poisson arrival rate walks a
+    geometric ladder on the SAME seeded trace (the exponential draws
+    rescale exactly) until goodput falls, the line reports
+    ``serve_max_goodput_under_slo`` + the knee rate, and a saturation
+    window at 2× the knee with a queue cap proves shedding engages
+    (nonzero ``serve_shed_rate``, bounded queue-wait p99).  Round 10: the default
     workload carries a shared system prefix and periodic 2×-length
     prompts, and the production windows run chunked prefill + the prefix
     pool — a monolithic/no-cache continuous run on the SAME seeded trace
@@ -1236,7 +1254,7 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
 
     from distributed_tensorflow_tpu.models import create_model
     from distributed_tensorflow_tpu.observability import (
-        NULL_TRACER, Tracer, serve_section)
+        NULL_TRACER, SLOMonitor, Tracer, serve_section)
     from distributed_tensorflow_tpu.parallel import mesh as meshlib
     from distributed_tensorflow_tpu.serving import (
         ContinuousBatcher, Request, SlotKVCache)
@@ -1269,6 +1287,14 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
     shared_len = int(env("BENCH_SERVE_SHARED_PREFIX",
                          str(prompt_len // 2)))
     long_every = int(env("BENCH_SERVE_LONG_EVERY", "4"))
+    # SLO targets (BASELINE.md "Goodput accounting": the SLO is part of
+    # the number — it rides the line's config) + the sweep/overload shape
+    if slo_ttft is None:
+        slo_ttft = float(env("BENCH_SERVE_SLO_TTFT", "1.0"))
+    if slo_itl is None:
+        slo_itl = float(env("BENCH_SERVE_SLO_ITL", "0.25"))
+    sweep_points = int(env("BENCH_SERVE_SWEEP_POINTS", "6"))
+    sweep_factor = float(env("BENCH_SERVE_SWEEP_FACTOR", "2.0"))
 
     mesh = with_backend_retry(meshlib.create_mesh)
     n = mesh.shape[meshlib.DATA_AXIS]
@@ -1311,10 +1337,14 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
                                rng.integers(0, vocab, pl).astype(np.int32)])
                for pl in p_lens]
 
-    def workload():
+    def workload(rate_scale: float = 1.0):
+        # one seeded trace for EVERY mode/rate: rescaling the exponential
+        # draws is an exact Poisson process at rate/rate_scale with the
+        # same request order and lengths — the --sweep ladder stays a
+        # same-trace comparison (BASELINE.md rule)
         return [Request(rid=i, prompt=prompts[i],
                         max_new_tokens=int(n_news[i]),
-                        arrival_s=float(arrivals[i]))
+                        arrival_s=float(arrivals[i] * rate_scale))
                 for i in range(n_requests)]
 
     # two tables, one workload: `kv` runs the round-10 production path
@@ -1379,7 +1409,13 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
     on_token = ((lambda rid, tok: delivered.__setitem__(0, delivered[0] + 1))
                 if stream else None)
 
-    def window(mode, table, budget, label):
+    def med(windows, key, vals=None):
+        if vals is None:
+            vals = [w[key] for w in windows if w.get(key) is not None]
+        vals = [v for v in vals if v is not None]
+        return statistics.median(vals) if vals else None
+
+    def window(mode, table, budget, label, rate_scale=1.0, cap=0):
         def _one(rep):
             delivered[0] = 0   # per-window count: the emitted number must
             if table.prefix_cache_blocks:
@@ -1387,9 +1423,11 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
                 # deterministic property of the workload, not of how many
                 # windows ran before this one
                 table.reset_prefix_cache()
-            batcher = ContinuousBatcher(table, tracer=tracer, mode=mode,
-                                        prefill_chunk=budget)
-            summary = serve_section(batcher.run(workload(),
+            # one SLOMonitor per window (goodput is a per-window number)
+            batcher = ContinuousBatcher(
+                table, tracer=tracer, mode=mode, prefill_chunk=budget,
+                slo=SLOMonitor(slo_ttft, slo_itl), queue_cap=cap)
+            summary = serve_section(batcher.run(workload(rate_scale),
                                                 on_token=on_token), n)
             if stream:         # describe ONE window, not every mode×repeat
                 summary["tokens_delivered"] = delivered[0]
@@ -1397,13 +1435,130 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
                  f"{summary['serve_requests_per_sec_per_chip']:.3f} "
                  f"req/s/chip, ttft_p95 "
                  f"{summary['serve_ttft_p95_s'] * 1e3:.1f} ms, "
-                 f"{summary['decode_iterations']} decode iterations")
+                 f"goodput {summary['serve_goodput_under_slo']:.3f}/s, "
+                 f"{summary['decode_iterations']} decode iterations, "
+                 f"{summary['shed_requests']} shed")
             return summary
         return _one
 
+    if sweep:
+        # ------------------------------------------------ SLO load harness
+        # walk the arrival rate up a geometric ladder on the SAME seeded
+        # trace; goodput-under-SLO rises with offered load until the
+        # batcher saturates, then falls (requests still complete, but
+        # outside the SLO) — the knee is the capacity number.  Early-stop
+        # once goodput falls below the best seen: points past the knee
+        # only measure collapse.
+        sweep_repeats = int(env("BENCH_SERVE_SWEEP_REPEATS", "1"))
+        ladder = []
+        best = None
+        try:
+            for k in range(sweep_points):
+                r = rate * sweep_factor ** k
+                wins = measure_windows(
+                    window("continuous", kv, chunk, f"sweep@{r:g}/s",
+                           rate_scale=rate / r),
+                    sweep_repeats, f"sweep@{r:g}", partial_errors)
+                if not wins:
+                    break
+                row = {
+                    "arrival_rate_per_s": r,
+                    "goodput_under_slo": med(wins,
+                                             "serve_goodput_under_slo"),
+                    "slo_attainment": med(
+                        wins, None,
+                        vals=[w["slo"]["slo_attainment"] for w in wins
+                              if w.get("slo")]),
+                    "requests_per_sec": med(wins, "serve_requests_per_sec"),
+                    "ttft_p99_s": med(wins, "serve_ttft_p99_s"),
+                    "itl_p99_s": med(wins, "serve_itl_p99_s"),
+                    "queue_wait_p99_s": med(wins,
+                                            "serve_queue_wait_p99_s"),
+                    "completed": med(wins, "completed"),
+                }
+                ladder.append(row)
+                g = row["goodput_under_slo"] or 0.0
+                note(f"sweep rate {r:g}/s: goodput {g:.3f}/s under SLO")
+                if best is None or g > (best["goodput_under_slo"] or 0.0):
+                    best = row
+                elif g < (best["goodput_under_slo"] or 0.0) * 0.95:
+                    note("goodput fell past the knee — early stop")
+                    break
+            knee = best["arrival_rate_per_s"] if best else None
+            max_goodput = best["goodput_under_slo"] if best else None
+            # saturation window: 2× the knee rate with bounded admission —
+            # proves the service DEGRADES (sheds with accounting, queue
+            # wait stays bounded) instead of collapsing into unbounded
+            # queue wait (the ISSUE/ROADMAP graceful-overload criterion)
+            over = None
+            over_rate = None
+            cap = queue_cap or slots
+            if knee:
+                over_rate = 2.0 * knee
+                over_wins = measure_windows(
+                    window("continuous", kv, chunk,
+                           f"overload@{over_rate:g}/s",
+                           rate_scale=rate / over_rate, cap=cap),
+                    sweep_repeats, "overload", partial_errors)
+                if over_wins:
+                    over = over_wins[0]
+        finally:
+            tracer.close()
+        print(json.dumps({
+            "metric": "gpt_serve_max_goodput_under_slo",
+            "value": (round(max_goodput, 4)
+                      if max_goodput is not None else None),
+            "unit": "requests/sec under SLO",
+            "vs_baseline": None,
+            "method": (f"Poisson arrival-rate sweep ×{sweep_factor:g} "
+                       f"from {rate:g}/s (same seeded trace, "
+                       f"{len(ladder)} points, early-stop on goodput "
+                       f"fall), SLO ttft≤{slo_ttft:g}s itl(p99)≤"
+                       f"{slo_itl:g}s; overload window at 2×knee with "
+                       f"queue cap {cap}"),
+            "serve_max_goodput_under_slo": max_goodput,
+            "serve_knee_rate_per_s": knee,
+            "sweep": ladder,
+            # the saturation window's accounting: shedding engaged,
+            # queue wait bounded, conservation exact
+            "serve_shed_rate": (over or {}).get("serve_shed_rate"),
+            "serve_overload_queue_wait_p99_s": (
+                (over or {}).get("serve_queue_wait_p99_s")),
+            "serve_overload_rate_per_s": over_rate,
+            "overload": over,
+            "slo": {"ttft_s": slo_ttft, "itl_s": slo_itl,
+                    "quantile": 0.99},
+            "config": {"slots": slots, "requests": n_requests,
+                       "base_arrival_rate_per_s": rate,
+                       "sweep_factor": sweep_factor,
+                       "sweep_points": sweep_points,
+                       "queue_cap": cap, "prompt_len": prompt_len,
+                       "max_new_tokens": max_new, "vocab": vocab,
+                       "hidden": hidden, "layers": layers,
+                       "heads": heads, "ffn": ffn, "max_len": max_len,
+                       "dtype": "bfloat16", "greedy": True,
+                       "prefill_chunk": chunk,
+                       "prefix_cache_blocks": cache_blocks,
+                       "prefix_block": prefix_block,
+                       "shared_prefix": shared_len,
+                       "long_every": long_every},
+            "device": device_kind,
+            "n_devices": n,
+            "synthetic": True,
+            "jax_version": jax.__version__,
+            "xla_flags": os.environ.get("XLA_FLAGS"),
+            "libtpu_init_args": os.environ.get("LIBTPU_INIT_ARGS"),
+            **({"partial": {"errors": partial_errors,
+                            "sweep_points_done": len(ladder)}}
+               if partial_errors else {}),
+        }))
+        return
+
     try:
-        # production path: chunked prefill + prefix pool
-        cont = measure_windows(window("continuous", kv, chunk, "serve"),
+        # production path: chunked prefill + prefix pool (+ the bounded-
+        # admission cap when --serve-queue-cap is set)
+        cont = measure_windows(window("continuous", kv, chunk, "serve",
+                                      cap=queue_cap),
                                repeats, "serve", partial_errors)
         if not cont:
             raise RuntimeError(f"no serve window completed: "
@@ -1423,20 +1578,25 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
         # to the failure are exactly the ones worth keeping
         tracer.close()
 
-    def med(windows, key):
-        vals = [w[key] for w in windows if w.get(key) is not None]
-        return statistics.median(vals) if vals else None
-
     serve_keys = ("serve_requests_per_sec_per_chip",
                   "serve_requests_per_sec", "serve_tokens_per_sec",
                   "serve_ttft_p50_s", "serve_ttft_p95_s",
+                  "serve_ttft_p99_s",
                   "serve_itl_p50_s", "serve_itl_p95_s",
+                  "serve_itl_p99_s",
                   # round 10: prefill/decode token split + prefix-pool
                   # hit rate ride the default AND --stream lines, so the
                   # BENCH_*.json serving trajectory captures them
                   "serve_prefill_tokens_per_sec",
                   "serve_decode_tokens_per_sec",
-                  "serve_prefix_cache_hit_rate")
+                  "serve_prefix_cache_hit_rate",
+                  # round 13: queue-pressure percentiles + goodput under
+                  # the SLO + shed accounting (0.0 shed at an uncapped
+                  # fixed rate — the key exists so `analyze diff` gates
+                  # it the day a cap or a regression sheds)
+                  "serve_queue_wait_p50_s", "serve_queue_wait_p95_s",
+                  "serve_queue_wait_p99_s",
+                  "serve_goodput_under_slo", "serve_shed_rate")
     line = {k: med(cont, k) for k in serve_keys}
     rps = line["serve_requests_per_sec_per_chip"]
     static_rps = med(stat, "serve_requests_per_sec_per_chip")
@@ -1458,6 +1618,12 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
         "serve_decode_iterations": med(cont, "decode_iterations"),
         "serve_completed": med(cont, "completed"),
         "serve_prefill_chunks": med(cont, "prefill_chunks"),
+        "serve_shed_requests": med(cont, "shed_requests"),
+        "serve_queue_depth_p95": med(cont, "queue_depth_p95"),
+        "slo": {"ttft_s": slo_ttft, "itl_s": slo_itl, "quantile": 0.99,
+                "attainment": med(cont, None,
+                                  vals=[(w.get("slo") or {}).get(
+                                      "slo_attainment") for w in cont])},
         # monolithic/no-cache continuous on the SAME trace: the ITL-p95
         # and TTFT-p50 deltas are THE round-10 headline numbers (decode
         # interference bounded by the chunk budget; shared prompts not
@@ -1494,7 +1660,9 @@ def bench_serve(stream: bool = False, trace_path: str | None = None) -> None:
                    "prefix_cache_blocks": cache_blocks,
                    "prefix_block": prefix_block,
                    "shared_prefix": shared_len,
-                   "long_every": long_every, "long_len": long_len},
+                   "long_every": long_every, "long_len": long_len,
+                   "slo_ttft_s": slo_ttft, "slo_itl_s": slo_itl,
+                   "queue_cap": queue_cap},
         "device": device_kind,
         "n_devices": n,
         "synthetic": True,
@@ -1516,6 +1684,7 @@ _MODE_METRICS = {
     "moe": "gpt_moe_sync_tokens_per_sec_per_chip",
     "decode": "gpt_lm_decode_tokens_per_sec_per_chip",
     "serve": "gpt_serve_requests_per_sec_per_chip",
+    "serve_sweep": "gpt_serve_max_goodput_under_slo",
     "default": "mnist_cnn_sync_examples_per_sec_per_chip",
 }
 
@@ -1545,7 +1714,32 @@ def main() -> None:
     p.add_argument("--trace", default=None, metavar="PATH",
                    help="--serve: write the scheduler's request/prefill/"
                         "decode span timeline to this JSONL (readable by "
-                        "observability.analyze spans/export)")
+                        "observability.analyze spans/export/serve)")
+    p.add_argument("--sweep", action="store_true",
+                   help="--serve: SLO load harness — sweep the Poisson "
+                        "arrival rate up a geometric ladder on the same "
+                        "seeded trace (early-stop once goodput falls), "
+                        "report serve_max_goodput_under_slo + the knee "
+                        "rate, and prove graceful overload with a "
+                        "queue-capped saturation window at 2× the knee "
+                        "(nonzero serve_shed_rate, bounded queue-wait "
+                        "p99); BENCH_SERVE_SWEEP_* env vars shape the "
+                        "ladder")
+    p.add_argument("--serve-slo-ttft", type=float, default=None,
+                   metavar="S",
+                   help="--serve: TTFT SLO target in seconds (default "
+                        "BENCH_SERVE_SLO_TTFT or 1.0) — goodput counts "
+                        "requests meeting this AND the ITL target")
+    p.add_argument("--serve-slo-itl", type=float, default=None,
+                   metavar="S",
+                   help="--serve: ITL SLO target in seconds, judged at "
+                        "each request's own p99 gap (default "
+                        "BENCH_SERVE_SLO_ITL or 0.25)")
+    p.add_argument("--serve-queue-cap", type=int, default=0, metavar="N",
+                   help="--serve: bounded admission — cap the arrived "
+                        "backlog at N, shed the excess with 429 "
+                        "accounting (the --sweep overload window uses "
+                        "this cap, defaulting to the slot count)")
     p.add_argument("--steps", type=int, default=100,
                    help="--stream: measured steps per repetition (the test "
                         "suite's smoke invocation shrinks this, plus "
@@ -1614,12 +1808,16 @@ def main() -> None:
             else "attention" if args.attention
             else "lm" if args.lm else "moe" if args.moe
             else "decode" if args.decode else "default")
-    metric = _MODE_METRICS[mode]
+    metric = (_MODE_METRICS["serve_sweep"]
+              if mode == "serve" and args.sweep else _MODE_METRICS[mode])
     if not args.no_probe:
         ensure_backend(metric)
     try:
         if mode == "serve":
-            bench_serve(stream=args.stream, trace_path=args.trace)
+            bench_serve(stream=args.stream, trace_path=args.trace,
+                        sweep=args.sweep, slo_ttft=args.serve_slo_ttft,
+                        slo_itl=args.serve_slo_itl,
+                        queue_cap=args.serve_queue_cap)
         elif mode == "stream":
             bench_stream(steps=max(args.steps, 1),
                          grad_compression=args.grad_compression,

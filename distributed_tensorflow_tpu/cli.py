@@ -227,6 +227,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "system prompt to every request (the dominant "
                         "real-traffic shape prefix caching exists for); "
                         "deterministic from --seed")
+    p.add_argument("--serve-slo-ttft", type=float, default=2.0,
+                   metavar="S",
+                   help="--serve: TTFT SLO target in seconds — a request "
+                        "is goodput only when arrival→first-token (queue "
+                        "wait included) meets this AND the ITL target; "
+                        "the serve section carries "
+                        "serve_goodput_under_slo (gated higher-is-better "
+                        "by `analyze diff`)")
+    p.add_argument("--serve-slo-itl", type=float, default=0.5,
+                   metavar="S",
+                   help="--serve: inter-token-latency SLO target in "
+                        "seconds, judged at each request's own p99 gap")
+    p.add_argument("--serve-queue-cap", type=int, default=0,
+                   metavar="N",
+                   help="--serve: bounded admission — cap the arrived-"
+                        "but-unadmitted backlog at N requests; excess "
+                        "sheds with 429 accounting (shed_requests / "
+                        "serve_shed_rate + a structured `overload` trace "
+                        "event) so overload degrades to bounded queue "
+                        "wait instead of unbounded TTFT (0 = admit "
+                        "everything)")
     p.add_argument("--model-arg", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="extra model constructor field (repeatable), e.g. "
@@ -564,6 +585,9 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         serve_prefix_cache=args.serve_prefix_cache,
         serve_prefix_block=args.serve_prefix_block,
         serve_shared_prefix=args.serve_shared_prefix,
+        serve_slo_ttft=args.serve_slo_ttft,
+        serve_slo_itl=args.serve_slo_itl,
+        serve_queue_cap=args.serve_queue_cap,
     )
     summary = run(config)  # run() itself wraps recovery when max_restarts>0
     print(json.dumps(summary))

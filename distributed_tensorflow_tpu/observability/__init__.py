@@ -24,6 +24,13 @@ fast path instead of disabling it:
              ratio, non-finite leaf count, loss-spike vs a running EMA)
              via optimizer-level capture transforms, plus the host-side
              anomaly policy behind ``--on-anomaly warn|halt``.
+  metrics  — LogHistogram / MetricsRegistry: streaming log-bucketed
+             histograms (fixed geometric buckets, O(1) record, mergeable
+             across windows/replicas) — serving latency p50/p95/p99
+             computed online without storing every sample.
+  slo      — SLOMonitor: goodput-under-SLO accounting (requests/sec
+             meeting BOTH the TTFT and ITL targets; shed requests are
+             offered load, never goodput).
   analyze  — the offline read side: span aggregation, stall summaries,
              Chrome-trace-event export (Perfetto-loadable), health
              timelines, and the run-vs-run regression diff.  Stdlib-only,
@@ -37,22 +44,29 @@ materialized once per chunk (one host sync per k steps), so enabling
 to ``steps_per_call=1`` (see Trainer.resolve_steps_per_call).
 """
 
+from distributed_tensorflow_tpu.observability.metrics import (
+    LogHistogram, MetricsRegistry, exact_percentile)
 from distributed_tensorflow_tpu.observability.report import (
     build_run_report, runtime_environment, serve_section)
 from distributed_tensorflow_tpu.observability.sink import (
     SCHEMA_VERSION, AsyncJsonlSink)
+from distributed_tensorflow_tpu.observability.slo import SLOMonitor
 from distributed_tensorflow_tpu.observability.trace import (
     NULL_TRACER, Tracer)
 
 __all__ = [
     "AsyncJsonlSink",
     "HealthConfig",
+    "LogHistogram",
+    "MetricsRegistry",
     "NULL_TRACER",
     "SCHEMA_VERSION",
+    "SLOMonitor",
     "Tracer",
     "build_run_report",
     "runtime_environment",
     "serve_section",
+    "exact_percentile",
 ]
 
 

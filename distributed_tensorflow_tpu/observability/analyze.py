@@ -8,6 +8,10 @@ answers:
   export TRACE.jsonl -o F  Chrome-trace-event JSON — load F in Perfetto
                            (https://ui.perfetto.dev) or chrome://tracing
   health METRICS.jsonl     health timeline: first anomaly step, stat maxima
+  serve TRACE.jsonl        per-request serving waterfall
+                           (queue→prefill-chunks→decode) from the
+                           scheduler's request/prefill_chunk spans +
+                           overload shed events; --text renders bars
   diff BASE NEW            run-vs-run regression diff of two run reports
                            (or BENCH_*.json lines); exits nonzero iff a
                            metric regressed beyond --threshold
@@ -85,6 +89,11 @@ def trace_summary(records: list[dict]) -> dict[str, Any]:
             counters[r["name"]] = r.get("total", 0)
     anomalies = [r for r in records if r.get("event") == "event"
                  and r.get("name") == "anomaly"]
+    # serving overload: one `overload` event per shed (429'd) request —
+    # surfaced here so `analyze spans` answers "did admission control
+    # engage" without a separate tool
+    overloads = [r for r in records if r.get("event") == "event"
+                 and r.get("name") == "overload"]
     # dispatch gaps: time between consecutive chunk_dispatch span STARTS
     # minus the span's own duration — host-side stall between dispatches
     dispatch = sorted((float(r["t"]), float(r.get("dur_s", 0.0)))
@@ -119,6 +128,7 @@ def trace_summary(records: list[dict]) -> dict[str, Any]:
             "anomaly_events": len(anomalies),
             "first_anomaly_step": (anomalies[0].get("step")
                                    if anomalies else None),
+            "overload_events": len(overloads),
         },
     }
 
@@ -182,6 +192,122 @@ def to_chrome_trace(records: list[dict]) -> dict[str, Any]:
     meta = [{"name": "process_name", "ph": "M", "pid": pid, "ts": 0,
              "args": {"name": label}} for pid, label in sorted(procs.items())]
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+# ------------------------------------------------------- serving waterfall
+
+def serve_waterfall(records: list[dict]) -> dict[str, Any]:
+    """Per-request phase waterfall from a serving trace: the scheduler's
+    ``request`` spans carry queue_wait_s/prefill_s/decode_s/ttft_s attrs
+    (attached at finish), ``prefill_chunk`` spans carry the chunk-by-
+    chunk fill, and ``overload`` events are the shed (429'd) requests.
+    One row per request SPAN (not per rid: a bench/sweep trace holds
+    several windows that all reuse rids 0..n−1 — every window's spans
+    get their own rows, and each chunk attaches to the request span
+    whose [start, end] interval contains it), arrival-ordered — the
+    queue→prefill-chunks→decode story of every request served."""
+    rows: list[dict[str, Any]] = []
+    chunk_recs: list[dict[str, Any]] = []
+    shed: list[dict[str, Any]] = []
+    for rec in records:
+        kind = rec.get("event")
+        rid = rec.get("rid")
+        if rid is None:
+            continue
+        if kind == "span" and rec.get("name") == "request":
+            rows.append({
+                "rid": rid,
+                "t": rec.get("t"),
+                "dur_s": rec.get("dur_s"),
+                "prompt_len": rec.get("prompt_len"),
+                "max_new_tokens": rec.get("max_new_tokens"),
+                "queue_wait_s": rec.get("queue_wait_s"),
+                "prefill_s": rec.get("prefill_s"),
+                "decode_s": rec.get("decode_s"),
+                "ttft_s": rec.get("ttft_s"),
+                "tokens": rec.get("tokens"),
+                "slo_met": rec.get("slo_met"),
+                "prefill_chunks": [],
+            })
+        elif kind == "span" and rec.get("name") == "prefill_chunk":
+            chunk_recs.append({
+                "rid": rid,
+                "t": rec.get("t"), "dur_s": rec.get("dur_s"),
+                "tokens": rec.get("tokens"), "start": rec.get("start")})
+        elif kind == "event" and rec.get("name") == "overload":
+            shed.append({"rid": rid, "t": rec.get("t"),
+                         "queue_depth": rec.get("queue_depth"),
+                         "queue_cap": rec.get("queue_cap")})
+    rows.sort(key=lambda r: (r["t"] is None, r["t"]))
+    # chunk → request-span attribution by containment: the chunk's entry
+    # time falls inside exactly one same-rid request span's interval
+    # (windows run sequentially, so same-rid intervals are disjoint);
+    # chunks of a request whose span never closed (killed window) drop
+    for c in sorted(chunk_recs, key=lambda c: (c["t"] is None, c["t"])):
+        if c["t"] is None:
+            continue
+        for row in rows:
+            if (row["rid"] == c["rid"] and row["t"] is not None
+                    and row["t"] <= c["t"]
+                    <= row["t"] + (row["dur_s"] or 0.0)):
+                row["prefill_chunks"].append(
+                    {k: v for k, v in c.items() if k != "rid"})
+                break
+    met = [r["slo_met"] for r in rows if r.get("slo_met") is not None]
+    return {
+        "requests": rows,
+        "shed": shed,
+        "requests_n": len(rows),
+        "shed_n": len(shed),
+        "slo_met_n": sum(bool(m) for m in met) if met else None,
+    }
+
+
+def render_waterfall_text(wf: dict[str, Any], width: int = 60) -> str:
+    """ASCII rendering of ``serve_waterfall``: one bar per request on a
+    shared wall-clock axis — '.' queue wait, '=' prefill, '#' decode —
+    plus a shed line per 429'd request.  Falls back to span duration when
+    a request has no phase attrs (a pre-round-13 trace)."""
+    rows = wf["requests"]
+    timed = [r for r in rows if r.get("t") is not None]
+    if not timed:
+        return "(no request spans in trace)"
+    t0 = min(r["t"] for r in timed)
+    # the span's t is its HOST entry (admission claim); the waterfall
+    # starts each bar at claim − queue_wait so the queue phase shows
+    starts = [r["t"] - (r.get("queue_wait_s") or 0.0) for r in timed]
+    ends = [r["t"] + (r.get("dur_s") or 0.0) for r in timed]
+    t0 = min(t0, min(starts))
+    span = max(max(ends) - t0, 1e-9)
+    scale = width / span
+    out = []
+    for r, start in zip(timed, starts):
+        q = r.get("queue_wait_s") or 0.0
+        p = r.get("prefill_s") or 0.0
+        d = r.get("decode_s")
+        d = (r.get("dur_s") or 0.0) - q - p if d is None else d
+        off = int((start - t0) * scale)
+        bar = (" " * off + "." * max(int(q * scale), 0)
+               + "=" * max(int(p * scale), 1)
+               + "#" * max(int(max(d, 0.0) * scale), 1))
+        slo = ("" if r.get("slo_met") is None
+               else (" SLO+" if r["slo_met"] else " SLO-"))
+        out.append(f"{str(r['rid']):>6} |{bar:<{width + 4}}| "
+                   f"q={q:.4f}s p={p:.4f}s d={max(d, 0.0):.4f}s"
+                   f"{slo}")
+    for s in wf["shed"]:
+        # clamp into the axis: overload events are emitted immediately
+        # while request spans only land at exit, so a partial trace can
+        # carry sheds PAST the last closed span's end — a negative pad
+        # width would crash the formatter
+        off = int((max(s["t"] - t0, 0.0)) * scale) if s.get("t") else 0
+        off = min(max(off, 0), width + 3)
+        out.append(f"{str(s['rid']):>6} |{' ' * off}x"
+                   f"{'':<{max(width + 3 - off, 0)}}"
+                   f"| shed (429) at depth {s.get('queue_depth')}")
+    out.append(f"legend: .=queue =prefill #=decode x=shed; "
+               f"{wf['requests_n']} served, {wf['shed_n']} shed")
+    return "\n".join(out)
 
 
 # ----------------------------------------------------------- health files
@@ -318,6 +444,20 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     ("serve_prefill_tokens_per_sec", "higher"),
     ("serve_decode_tokens_per_sec", "higher"),
     ("serve_prefix_cache_hit_rate", "higher"),
+    # SLO-aware serving observability (round 13; BASELINE.md "Goodput
+    # accounting"): tail latency gates at p99 — the percentile the SLO is
+    # written against — queue wait p99 bounds the admission backlog
+    # (overload mode exists to keep THIS bounded), goodput-under-SLO and
+    # the swept maximum are THE headline serving numbers (higher), and
+    # the shed rate at a fixed offered rate must not grow (shedding more
+    # at equal load is lost goodput even though shedding per se is the
+    # designed overload behavior)
+    ("serve_ttft_p99_s", "lower"), ("serve_itl_p99_s", "lower"),
+    ("serve_queue_wait_p99_s", "lower"),
+    ("serve_goodput_under_slo", "higher"),
+    ("serve_max_goodput_under_slo", "higher"),
+    ("serve_knee_rate_per_s", "higher"),
+    ("serve_shed_rate", "lower"),
 )
 
 
@@ -458,6 +598,15 @@ def main(argv: list[str] | None = None) -> int:
     he.add_argument("--spike-factor", type=float, default=10.0,
                     help="loss-spike anomaly factor (HealthConfig default)")
 
+    sv = sub.add_parser("serve", help="per-request serving waterfall "
+                                      "(queue→prefill-chunks→decode)")
+    sv.add_argument("trace", help="serving trace JSONL (--trace output "
+                                  "of a --serve run or bench --serve)")
+    sv.add_argument("--text", action="store_true",
+                    help="render ASCII bars instead of JSON")
+    sv.add_argument("--width", type=int, default=60,
+                    help="--text: bar width in characters")
+
     df = sub.add_parser("diff", help="run-vs-run regression diff "
                                      "(exit 1 iff a metric regressed)")
     df.add_argument("base", help="baseline report/summary/bench JSON(L)")
@@ -477,6 +626,13 @@ def main(argv: list[str] | None = None) -> int:
         print(f"wrote {out}: {len(trace['traceEvents'])} events "
               f"({n} spans) — load it at https://ui.perfetto.dev",
               file=sys.stderr)
+        return 0
+    if args.cmd == "serve":
+        wf = serve_waterfall(read_jsonl(args.trace))
+        if args.text:
+            print(render_waterfall_text(wf, width=args.width))
+        else:
+            print(json.dumps(wf, indent=2))
         return 0
     if args.cmd == "health":
         print(json.dumps(health_timeline(

@@ -62,16 +62,18 @@ def serve_section(summary: dict[str, Any] | None,
                   n_devices: int = 1) -> dict[str, Any] | None:
     """Normalize a ContinuousBatcher summary into the run-report/bench
     ``serve`` section: the per-request result objects are dropped (the
-    section must stay JSON), and the per-chip throughput — THE gated
-    serving headline, mirroring examples_per_sec_per_device — is derived
-    here so every surface divides by the same device count."""
+    section must stay JSON), and the per-chip rates — requests/sec (the
+    round-7 headline) and goodput-under-SLO (the round-13 one, mirroring
+    examples_per_sec_per_device) — are derived here so every surface
+    divides by the same device count."""
     if summary is None:
         return None
     sec = {k: v for k, v in summary.items() if k != "results"}
-    rps = sec.get("serve_requests_per_sec")
-    sec["serve_requests_per_sec_per_chip"] = (
-        rps / n_devices if isinstance(rps, (int, float)) and n_devices
-        else None)
+    for key in ("serve_requests_per_sec", "serve_goodput_under_slo"):
+        v = sec.get(key)
+        sec[f"{key}_per_chip"] = (
+            v / n_devices if isinstance(v, (int, float)) and n_devices
+            else None)
     return sec
 
 

@@ -132,16 +132,19 @@ class Tracer:
             })
 
     @contextlib.contextmanager
-    def span(self, name: str, **attrs: Any) -> Iterator[None]:
+    def span(self, name: str, **attrs: Any) -> Iterator[dict]:
         """Time a named region; one JSONL event at exit, plus the in-memory
-        aggregate the run report reads."""
+        aggregate the run report reads.  Yields the span's attr dict —
+        keys added to it BEFORE exit ride the emitted record, which is how
+        the serving scheduler attaches per-request phase attribution
+        (queue_wait_s/prefill_s/decode_s) computed only at finish."""
         t_mono = time.monotonic()
         t0 = time.perf_counter()
         ctx = _profiler_annotation(name) if self._annotate \
             else contextlib.nullcontext()
         with ctx:
             try:
-                yield
+                yield attrs
             finally:
                 dur = time.perf_counter() - t0
                 t_book = time.perf_counter()

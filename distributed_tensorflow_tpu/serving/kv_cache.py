@@ -49,6 +49,7 @@ the cold prefill would write).
 from __future__ import annotations
 
 import hashlib
+import time
 from collections import OrderedDict
 
 import jax
@@ -196,6 +197,12 @@ class SlotKVCache:
         # scheduler reads deltas of this for the prefill/decode token
         # split and the VirtualClock interference model
         self.prefill_tokens_computed = 0
+
+        # host-observed seconds inside the compiled programs, per phase
+        # (cumulative; the scheduler reads deltas per run) — the device
+        # half of the per-request phase attribution: how much of a
+        # window went to prefill programs vs decode steps
+        self._phase_s = {"prefill_s": 0.0, "decode_s": 0.0}
 
         self._step = self._build_step()
         self._prefills: dict[int, object] = {}
@@ -413,9 +420,11 @@ class SlotKVCache:
         if lpad not in self._prefills:
             self._prefills[lpad] = self._prefill(lpad)
         fn = self._prefills[lpad]
+        t0 = time.perf_counter()
         self.cache, first = fn(
             self.params, self.cache, jnp.int32(slot),
             self._put_repl(padded), jnp.int32(lp), self._next_rng())
+        self._phase_s["prefill_s"] += time.perf_counter() - t0
         self.prefill_tokens_computed += lp
         self.active[slot] = True
         self.lengths[slot] = lp
@@ -468,10 +477,12 @@ class SlotKVCache:
         padded[:n] = pend["prompt"][filled:filled + n]
         if lpad not in self._chunks:
             self._chunks[lpad] = self._chunk(lpad)
+        t0 = time.perf_counter()
         self.cache, first = self._chunks[lpad](
             self.params, self.cache, jnp.int32(slot),
             self._put_repl(padded), jnp.int32(filled), jnp.int32(n),
             self._next_rng())
+        self._phase_s["prefill_s"] += time.perf_counter() - t0
         pend["filled"] = filled + n
         self.lengths[slot] = filled + n
         self.prefill_tokens_computed += n
@@ -614,11 +625,13 @@ class SlotKVCache:
                 f"active slot at length {int(live.max())} would write past "
                 f"max_len={self.max_len}; the scheduler must bound "
                 f"prompt + max_new_tokens at admission")
+        t0 = time.perf_counter()
         self.cache, nxt = self._step(
             self.params, self.cache, self._put_vec(self.tokens),
             self._put_vec(self.lengths),
             self._put_vec(self.active), self._next_rng())
         nxt = np.asarray(nxt)
+        self._phase_s["decode_s"] += time.perf_counter() - t0
         self.lengths[self.active] += 1
         self.tokens = nxt.astype(np.int32)
         return nxt
@@ -632,6 +645,14 @@ class SlotKVCache:
         self.active[slot] = False
         self.lengths[slot] = 0
         self.tokens[slot] = 0
+
+    def phase_times(self) -> dict[str, float]:
+        """Cumulative host-observed seconds inside the compiled prefill
+        (monolithic + chunk) and decode programs — the device-side phase
+        timestamps behind the scheduler's ``device_phase_s`` split.  Host-
+        observed: each program's result is materialized before the next
+        scheduling decision, so dispatch + device wait both land here."""
+        return dict(self._phase_s)
 
     def compiled_programs(self) -> dict[str, int]:
         """The recompile-freedom invariant the tests pin down: one decode

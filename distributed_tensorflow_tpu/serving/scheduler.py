@@ -31,10 +31,33 @@ Latency accounting follows the MLPerf inference convention (Mattson et
 al., arXiv:1910.01500 — latency percentiles as machine-checked numbers):
 TTFT is arrival→first-token (queue wait INCLUDED — an admitted-late
 request is a slow request), ITL is the gap between consecutive token
-deliveries, and both report p50/p95 over the whole run.  Every request
+deliveries, and both report p50/p95/p99 over the whole run.  Every request
 emits ``request``/``prefill``/``decode`` trace spans through the existing
 observability stack, so `analyze spans` and the Perfetto export read
 serving timelines with no new machinery.
+
+Round 13 makes the batcher service-grade observable — all host-side, so
+the compiled program set and the greedy tokens stay byte-identical:
+
+* **per-phase attribution**: each request's queue wait (arrival→claim),
+  prefill (claim→first token, chunk wait included) and decode gaps land
+  in a streaming log-bucketed histogram registry
+  (observability/metrics.py — O(1) record, online p50/p95/p99,
+  mergeable across windows) AND as attrs on the ``request`` span, which
+  is what ``analyze serve`` renders as a per-request waterfall;
+* **goodput under SLO**: an attached ``SLOMonitor``
+  (observability/slo.py) judges every completed request against TTFT +
+  ITL targets and the summary carries ``serve_goodput_under_slo`` —
+  requests/sec that met BOTH, the MLPerf/Sarathi-Serve headline;
+* **bounded-admission overload mode** (``queue_cap > 0``): arrived
+  backlog past the cap is shed with exact 429 accounting
+  (``shed_requests``/``serve_shed_rate``, a structured ``overload``
+  trace event per rejection, admitted + shed + unserved == offered), so
+  an overloaded batcher degrades to bounded queue wait instead of
+  unbounded TTFT;
+* **lease drain** (``should_stop``): the PR 9 preemption hook — a
+  SIGTERM'd serve window stops admitting, finishes in-flight requests
+  and flushes a consistent partial summary (``preempted`` names why).
 
 Clocks are injectable: ``WallClock`` (real time; idle waits sleep until
 the next arrival — the open-loop bench) or ``VirtualClock`` (time = decode
@@ -43,6 +66,7 @@ iterations; deterministic staggered-arrival tests).
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import dataclasses
 import time
@@ -50,6 +74,8 @@ from typing import Any, Callable, Iterable
 
 import numpy as np
 
+from distributed_tensorflow_tpu.observability.metrics import (
+    MetricsRegistry, exact_percentile)
 from distributed_tensorflow_tpu.observability.trace import NULL_TRACER
 from distributed_tensorflow_tpu.serving.kv_cache import SlotKVCache
 
@@ -151,6 +177,10 @@ class RequestQueue:
             requests, key=lambda r: (r.arrival_s, r.rid))
         self.busy = False
         self.claim_attempts = 0   # attempts of the LAST claim() call
+        # deepest ARRIVED backlog ever observed via depth(now) — the
+        # queue-pressure number that used to be invisible until TTFT
+        # blew up
+        self.depth_high_watermark = 0
 
     def push(self, request: Request) -> None:
         self._items.append(request)
@@ -166,6 +196,33 @@ class RequestQueue:
         if self._items and self._items[0].arrival_s <= now:
             return self._items.pop(0)
         return None
+
+    def depth(self, now: float | None = None) -> int:
+        """Queue depth: all queued requests when ``now`` is None, else
+        only those already ARRIVED by ``now`` (the admission backlog —
+        the number bounded-admission caps).  ``now``-based reads update
+        ``depth_high_watermark``.  O(log n): the batcher calls this every
+        decode iteration, and a linear scan would make the host loop
+        quadratic in the backlog exactly when overloaded."""
+        if now is None:
+            return len(self._items)
+        d = bisect.bisect_right(self._items, now,
+                                key=lambda r: r.arrival_s)
+        if d > self.depth_high_watermark:
+            self.depth_high_watermark = d
+        return d
+
+    def shed_ready(self, now: float, keep: int) -> list[Request]:
+        """Bounded admission: remove and return every ARRIVED request
+        beyond the oldest ``keep`` (the 429 path — newest arrivals shed
+        first, FIFO preserved for the survivors)."""
+        ready = self.depth(now)
+        n_shed = ready - max(int(keep), 0)
+        if n_shed <= 0:
+            return []
+        shed = self._items[ready - n_shed:ready]
+        del self._items[ready - n_shed:ready]
+        return shed
 
     @contextlib.contextmanager
     def claim(self, max_attempts: int = 8, backoff_s: float = 0.005):
@@ -199,7 +256,14 @@ class RequestQueue:
 
 @dataclasses.dataclass
 class RequestResult:
-    """Per-request outcome + latency timeline (clock units)."""
+    """Per-request outcome + latency timeline (clock units).
+
+    Phase attribution: ``queue_wait_s`` is arrival → slot claim,
+    ``prefill_s`` is claim → first token (chunk wait included), and the
+    decode phase is the ``itl_s`` gap list — the three sum (with the
+    decode gaps) to the request's total latency, and each phase also
+    lands in the batcher's histogram registry and on the ``request``
+    trace span."""
 
     rid: int
     prompt_len: int
@@ -209,36 +273,36 @@ class RequestResult:
     first_token_s: float
     finished_s: float = 0.0
     itl_s: list[float] = dataclasses.field(default_factory=list)
+    queue_wait_s: float = 0.0
+    prefill_s: float = 0.0
+    slo_met: bool | None = None   # None: no SLOMonitor attached
 
     @property
     def ttft_s(self) -> float:
         return self.first_token_s - self.arrival_s
+
+    @property
+    def decode_s(self) -> float:
+        return self.finished_s - self.first_token_s
 
 
 class _Live:
     """Host bookkeeping for one in-flight slot."""
 
     def __init__(self, req: Request, result: RequestResult,
-                 req_span, dec_span, last_t: float):
+                 req_span, dec_span, last_t: float, req_attrs=None):
         self.req = req
         self.result = result
         self.req_span = req_span     # entered context managers, exited on
         self.dec_span = dec_span     # finish (per-request span contract)
+        self.req_attrs = req_attrs if req_attrs is not None else {}
         self.last_t = last_t
 
 
-def _percentile(vals: list[float], q: float) -> float | None:
-    """Linear-interpolated percentile (stdlib-only math so the summary is
-    recomputable anywhere the JSONL lands)."""
-    if not vals:
-        return None
-    s = sorted(vals)
-    if len(s) == 1:
-        return s[0]
-    pos = (len(s) - 1) * q
-    lo = int(pos)
-    hi = min(lo + 1, len(s) - 1)
-    return s[lo] + (s[hi] - s[lo]) * (pos - lo)
+# stdlib-only linear-interpolated percentile (shared with the histogram
+# module so the stored-sample path and the exactness tests use literally
+# the same function)
+_percentile = exact_percentile
 
 
 # --------------------------------------------------------------- batcher
@@ -254,13 +318,18 @@ class ContinuousBatcher:
 
     def __init__(self, kv: SlotKVCache, *, tracer=NULL_TRACER,
                  clock=None, mode: str = "continuous",
-                 prefill_chunk: int = 0):
+                 prefill_chunk: int = 0, metrics=None, slo=None,
+                 queue_cap: int = 0, should_stop=None):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be continuous|static, got {mode}")
         if prefill_chunk < 0:
             raise ValueError(
                 f"prefill_chunk must be >= 0 (0 = monolithic prefill), "
                 f"got {prefill_chunk}")
+        if queue_cap < 0:
+            raise ValueError(
+                f"queue_cap must be >= 0 (0 = unbounded admission), got "
+                f"{queue_cap}")
         self.kv = kv
         self.tracer = tracer
         self.clock = clock if clock is not None else WallClock()
@@ -271,6 +340,19 @@ class ContinuousBatcher:
         # rides each decode iteration, so live slots keep emitting tokens
         # while a long prompt fills
         self.prefill_chunk = int(prefill_chunk)
+        # observability hooks — ALL host-side, so the compiled program set
+        # and the greedy tokens are byte-identical with them on or off:
+        # `metrics` is an external MetricsRegistry the per-run histograms
+        # merge into (windows → runs → fleet), `slo` an SLOMonitor
+        # (goodput-under-SLO per window), `queue_cap` the bounded-
+        # admission overload mode (>0: arrived backlog past the cap is
+        # shed with 429 accounting instead of queuing unboundedly), and
+        # `should_stop` the lease-drain hook (reason string → stop
+        # admitting, finish in-flight, flush accounting)
+        self.metrics = metrics
+        self.slo = slo
+        self.queue_cap = int(queue_cap)
+        self.should_stop = should_stop
         self.idle_polls = 0
 
     # ------------------------------------------------------------ admission
@@ -289,9 +371,10 @@ class ContinuousBatcher:
     def _admit(self, req: Request, live: dict[int, _Live]) -> int:
         kv, tracer = self.kv, self.tracer
         lp = self._check_capacity(req)
+        t_claim = self.clock.now()
         req_span = tracer.span("request", rid=req.rid, prompt_len=lp,
                                max_new_tokens=req.max_new_tokens)
-        req_span.__enter__()
+        req_attrs = req_span.__enter__() or {}
         before = kv.prefill_tokens_computed
         with tracer.span("prefill", rid=req.rid, prompt_len=lp):
             slot, first = kv.insert(req.prompt)
@@ -299,10 +382,12 @@ class ContinuousBatcher:
         now = self.clock.now()
         result = RequestResult(
             rid=req.rid, prompt_len=lp, tokens=[first],
-            arrival_s=req.arrival_s, admitted_s=now, first_token_s=now)
+            arrival_s=req.arrival_s, admitted_s=now, first_token_s=now,
+            queue_wait_s=t_claim - req.arrival_s,
+            prefill_s=now - t_claim)
         dec_span = tracer.span("decode", rid=req.rid, slot=slot)
         dec_span.__enter__()
-        live[slot] = _Live(req, result, req_span, dec_span, now)
+        live[slot] = _Live(req, result, req_span, dec_span, now, req_attrs)
         if self._finished(live[slot]):
             # max_new_tokens == 1 (or instant EOS): the prefill's token was
             # the whole continuation — finish without a decode iteration
@@ -316,12 +401,15 @@ class ContinuousBatcher:
         the arrival→first-token meaning, queue AND chunk wait included."""
         kv, tracer = self.kv, self.tracer
         lp = self._check_capacity(req)
+        t_claim = self.clock.now()
         req_span = tracer.span("request", rid=req.rid, prompt_len=lp,
                                max_new_tokens=req.max_new_tokens)
-        req_span.__enter__()
+        req_attrs = req_span.__enter__() or {}
         slot, reused = kv.begin_insert(req.prompt)
         pending[slot] = {"req": req, "span": req_span, "lp": lp,
-                         "admitted_s": self.clock.now(), "reused": reused}
+                         "admitted_s": t_claim, "reused": reused,
+                         "attrs": req_attrs,
+                         "queue_wait_s": t_claim - req.arrival_s}
 
     def _promote(self, slot: int, pend: dict, first: int,
                  live: dict[int, _Live]) -> None:
@@ -331,10 +419,13 @@ class ContinuousBatcher:
         result = RequestResult(
             rid=req.rid, prompt_len=pend["lp"], tokens=[first],
             arrival_s=req.arrival_s, admitted_s=pend["admitted_s"],
-            first_token_s=now)
+            first_token_s=now,
+            queue_wait_s=pend["queue_wait_s"],
+            prefill_s=now - pend["admitted_s"])
         dec_span = self.tracer.span("decode", rid=req.rid, slot=slot)
         dec_span.__enter__()
-        live[slot] = _Live(req, result, pend["span"], dec_span, now)
+        live[slot] = _Live(req, result, pend["span"], dec_span, now,
+                           pend["attrs"])
         if self._finished(live[slot]):
             self._finish(slot, live)
 
@@ -346,18 +437,66 @@ class ContinuousBatcher:
 
     def _finish(self, slot: int, live: dict[int, _Live]) -> None:
         lv = live.pop(slot)
-        lv.result.finished_s = self.clock.now()
+        r = lv.result
+        r.finished_s = self.clock.now()
+        # phase attribution: histogram observations (online percentiles,
+        # mergeable across windows) + the same numbers as attrs on the
+        # request span record, so `analyze serve` can render the
+        # queue→prefill→decode waterfall from the trace alone
+        reg = self._registry
+        reg.record("ttft", r.ttft_s)
+        reg.record("queue_wait", r.queue_wait_s)
+        reg.record("prefill", r.prefill_s)
+        for gap in r.itl_s:
+            reg.record("itl", gap)
+        if self.slo is not None:
+            r.slo_met = self.slo.observe(r.ttft_s, r.itl_s)
+        lv.req_attrs.update(
+            queue_wait_s=r.queue_wait_s, prefill_s=r.prefill_s,
+            decode_s=r.decode_s, ttft_s=r.ttft_s, tokens=len(r.tokens),
+            **({} if r.slo_met is None else {"slo_met": r.slo_met}))
         lv.dec_span.__exit__(None, None, None)
         lv.req_span.__exit__(None, None, None)
         self.kv.evict(slot)
         self._results.append(lv.result)
 
-    def _idle_wait(self, queue: RequestQueue, target: float) -> None:
+    def _shed(self, req: Request, depth: int) -> None:
+        """Bounded-admission rejection (the 429 path): exact accounting —
+        a structured ``overload`` trace event + counter, the SLO monitor's
+        shed ledger (shed is offered load, never goodput), and a bounded
+        record list for the summary."""
+        self._shed_count += 1
+        if len(self._shed_rids) < 128:   # bounded: accounting, not a log
+            self._shed_rids.append(req.rid)
+        self.tracer.event("overload", rid=req.rid, queue_depth=depth,
+                          queue_cap=self.queue_cap,
+                          arrival_s=req.arrival_s)
+        self.tracer.counter("shed_requests")
+        if self.slo is not None:
+            self.slo.shed()
+
+    def _check_preempt(self, iters: int, queue: RequestQueue) -> bool:
+        """Consult the lease-drain hook once (sticky): the first reason it
+        returns stops admission and emits the structured drain event."""
+        if self.should_stop is not None and self._preempted is None:
+            reason = self.should_stop(iters)
+            if reason:
+                self._preempted = reason
+                self.tracer.event("serve_preempted", reason=reason,
+                                  completed=len(self._results),
+                                  unserved=len(queue))
+        return self._preempted is not None
+
+    def _idle_wait(self, queue: RequestQueue, target: float,
+                   iters: int) -> None:
         """Wait for the next arrival in bounded poll slices (the clock's
         ``poll_slice_s``): each slice re-reads the queue head, so a
         concurrent producer's earlier push is noticed within one slice and
         an idle batcher costs a counted, bounded number of wakeups — never
-        a hot spin."""
+        a hot spin.  Each slice also consults the lease-drain hook: a
+        preemption notice landing in a long idle gap must drain within
+        one slice, not after the next arrival (typical grace periods are
+        ~30 s — shorter than a sparse workload's gaps)."""
         clock = self.clock
         slice_s = getattr(clock, "poll_slice_s", float("inf"))
         while True:
@@ -365,6 +504,8 @@ class ContinuousBatcher:
             nxt = queue.next_arrival()
             if nxt is None or now >= nxt:
                 return
+            if self._check_preempt(iters, queue):
+                return   # the loop top turns this into the drain/break
             self.idle_polls += 1
             clock.wait_until(min(nxt, now + slice_s))
 
@@ -380,10 +521,21 @@ class ContinuousBatcher:
         prefills = 0
         chunks = 0
         while len(queue) or live or pending:
+            # lease drain (should_stop hook, the PR 9 contract): a
+            # preemption notice stops admission — in-flight slots finish,
+            # claimed (pending) admissions complete, the rest of the
+            # queue is left unserved and accounted — so a SIGTERM'd serve
+            # window flushes a consistent partial summary instead of
+            # dying mid-table
+            self._check_preempt(decode_iterations, queue)
+            if self._preempted is not None and not (live or pending):
+                break
             # admission between decode iterations: continuous mode
             # fills any free slot from the arrived queue; static mode
             # waits for the whole table to drain first
-            can_admit = self.mode == "continuous" or not (live or pending)
+            can_admit = (self._preempted is None
+                         and (self.mode == "continuous"
+                              or not (live or pending)))
             while can_admit and kv.free_slots:
                 req = queue.pop_ready(clock.now())
                 if req is None:
@@ -395,6 +547,22 @@ class ContinuousBatcher:
                     prefills += 1
                     if on_token is not None:
                         on_token(req.rid, first)  # the prefill's own token
+            # bounded admission (overload mode): whatever arrived beyond
+            # the queue-depth cap after this round's admissions is shed
+            # with 429 accounting — queue wait stays bounded by
+            # construction instead of growing with offered load
+            if self.queue_cap and self._preempted is None:
+                now = clock.now()
+                # depth BEFORE shedding: the overload events must record
+                # the backlog that triggered them (post-shed depth is
+                # always == queue_cap — zero information)
+                depth = queue.depth(now)
+                for req in queue.shed_ready(now, self.queue_cap):
+                    self._shed(req, depth)
+            # queue-pressure attribution: the arrived backlog, per
+            # iteration, into the histogram the summary's
+            # queue_depth_p95 reads (+ the queue's own high watermark)
+            self._registry.record("queue_depth", queue.depth(clock.now()))
             # at most ONE ≤budget-token chunk rides each iteration: the
             # decode stall a filling prompt can inflict is bounded by the
             # chunk budget, whatever the prompt length
@@ -420,7 +588,8 @@ class ContinuousBatcher:
                 nxt = queue.next_arrival()
                 if nxt is None:
                     break
-                self._idle_wait(queue, nxt)  # bounded-slice sleep/jump
+                self._idle_wait(queue, nxt,  # bounded-slice sleep/jump
+                                decode_iterations)
                 continue
             with tracer.span("decode_step", active=len(live)):
                 toks = kv.advance()
@@ -448,13 +617,24 @@ class ContinuousBatcher:
         is the streaming hook — called at each token's host delivery."""
         queue = (requests if isinstance(requests, RequestQueue)
                  else RequestQueue(requests))
+        offered = len(queue)
         self._results: list[RequestResult] = []
         self._decode_tokens = 0
         self.idle_polls = 0
+        # fresh per-run registry (the summary's histograms describe THIS
+        # window); an external self.metrics registry accumulates the
+        # merged per-window histograms across windows/replicas
+        self._registry = MetricsRegistry()
+        self._shed_count = 0
+        self._shed_rids: list[int] = []
+        self._preempted: str | None = None
+        if self.slo is not None:
+            self.slo.reset()   # one monitor measures one window
         live: dict[int, _Live] = {}
         pending: dict[int, dict] = {}
         prefix_before = self.kv.prefix_cache_stats()
         prefill_before = self.kv.prefill_tokens_computed
+        phases_before = self.kv.phase_times()
         with queue.claim():
             self.clock.start()
             t_start = self.clock.now()
@@ -491,7 +671,19 @@ class ContinuousBatcher:
         results = sorted(self._results, key=lambda r: r.rid)
         ttfts = [r.ttft_s for r in results]
         itls = [g for r in results for g in r.itl_s]
+        queue_waits = [r.queue_wait_s for r in results]
         tokens = sum(len(r.tokens) for r in results)
+        # overload/drain conservation ledger: every offered request is
+        # admitted (and completed — run() drains), shed, or left unserved
+        # by a lease drain; admitted + shed + unserved == offered exactly
+        admitted = len(results)
+        unserved = len(queue)
+        slo_sec = (self.slo.summary(elapsed) if self.slo is not None
+                   else None)
+        if self.metrics is not None:
+            self.metrics.merge(self._registry)
+        depth_hist = self._registry.histogram("queue_depth")
+        phases_after = self.kv.phase_times()
         # prefill/decode token split + prefix-pool accounting, as deltas
         # over this run (bench windows share one SlotKVCache)
         prefill_tokens = self.kv.prefill_tokens_computed - prefill_before
@@ -537,7 +729,48 @@ class ContinuousBatcher:
             "prefix_cache": prefix_sec,
             "serve_ttft_p50_s": _percentile(ttfts, 0.50),
             "serve_ttft_p95_s": _percentile(ttfts, 0.95),
+            "serve_ttft_p99_s": _percentile(ttfts, 0.99),
             "serve_itl_p50_s": _percentile(itls, 0.50),
             "serve_itl_p95_s": _percentile(itls, 0.95),
+            "serve_itl_p99_s": _percentile(itls, 0.99),
+            # queue-pressure attribution (stored-sample path, like the
+            # TTFT/ITL percentiles above; the histogram copies ride the
+            # `histograms` section below and are asserted within one
+            # bucket width of these)
+            "serve_queue_wait_p50_s": _percentile(queue_waits, 0.50),
+            "serve_queue_wait_p95_s": _percentile(queue_waits, 0.95),
+            "serve_queue_wait_p99_s": _percentile(queue_waits, 0.99),
+            "queue_depth_p95": depth_hist.quantile(0.95),
+            "queue_depth_high_watermark": queue.depth_high_watermark,
+            # bounded-admission overload accounting (exact conservation:
+            # admitted + shed + unserved == offered)
+            "queue_cap": self.queue_cap,
+            "offered": offered,
+            "admitted": admitted,
+            "shed_requests": self._shed_count,
+            "shed_rids": list(self._shed_rids),
+            "unserved_requests": unserved,
+            "serve_shed_rate": (self._shed_count / offered
+                                if offered else 0.0),
+            # lease drain: the should_stop reason when this window was
+            # preempted mid-run (None = ran to completion) — the partial
+            # accounting above is still exact
+            "preempted": self._preempted,
+            # goodput under the SLO (requests/sec meeting BOTH targets;
+            # None when no SLOMonitor is attached) + the monitor's section
+            "serve_goodput_under_slo": (
+                slo_sec.get("goodput_requests_per_sec")
+                if slo_sec else None),
+            "slo": slo_sec,
+            # online log-bucketed histograms of the per-phase attribution
+            # (queue_wait / prefill / ttft / itl / queue_depth): p50/95/99
+            # within one bucket's relative width of the stored-sample
+            # percentiles, mergeable across windows via `metrics=`
+            "histograms": self._registry.snapshot(),
+            # host-observed seconds inside the kv's compiled programs,
+            # as deltas over this run (SlotKVCache.phase_times)
+            "device_phase_s": {
+                k: phases_after[k] - phases_before.get(k, 0.0)
+                for k in phases_after},
             "results": results,
         }

@@ -254,6 +254,25 @@ class ExperimentConfig:
                                            # every request (the shared-
                                            # prefix traffic shape;
                                            # deterministic from seed)
+    serve_slo_ttft: float = 2.0            # TTFT SLO target in seconds:
+                                           # a request is goodput only
+                                           # when arrival→first-token
+                                           # (queue wait included) meets
+                                           # this AND the ITL target
+    serve_slo_itl: float = 0.5             # ITL SLO target in seconds,
+                                           # judged at each request's own
+                                           # p99 inter-token gap
+    serve_queue_cap: int = 0               # >0: bounded admission — the
+                                           # arrived-but-unadmitted
+                                           # backlog is capped; excess
+                                           # sheds with 429 accounting
+                                           # (shed_requests/
+                                           # serve_shed_rate + a
+                                           # structured `overload` trace
+                                           # event) so overload degrades
+                                           # to bounded queue wait, not
+                                           # unbounded TTFT.  0 = admit
+                                           # everything (PR 10 behavior)
 
 
 def enable_compile_cache(directory: str | os.PathLike) -> str:
@@ -1647,9 +1666,14 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         finally:
             if watchdog is not None:
                 watchdog.close()
-            if lease is not None:
-                # restore the previous SIGTERM disposition: a later run in
-                # this process must not drain into THIS run's lease
+            if lease is not None and not config.serve_requests:
+                # restore the previous SIGTERM disposition as soon as
+                # training ends: nothing after fit consults the lease on
+                # a non-serving run, and a still-armed handler would
+                # SWALLOW a preemption notice during eval/report.  With
+                # --serve the lease stays armed through the serving
+                # window (its should_stop hook drains it) and the outer
+                # finally uninstalls (idempotent) afterwards.
                 lease.uninstall()
         if config.grad_bucket_mb:
             # ride the fit result into the run report (None when the
@@ -1732,8 +1756,16 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                                               test_ds))
         serve_sec = None
         if config.serve_requests:
+            # the serve window rides the lease's SIGNAL hook only (budget
+            # steps are a TRAINING budget — a budget-drained fit still
+            # runs its cheap post-work, but a preemption notice drains
+            # the serving loop too: stop admitting, finish in-flight,
+            # flush the partial section into the report before exit)
+            serve_stop = ((lambda _iters: lease.should_stop(0))
+                          if lease is not None else None)
             serve_sec = _serve_from_state(config, ex, trainer.state,
-                                          test_ds, tracer, total_devices)
+                                          test_ds, tracer, total_devices,
+                                          should_stop=serve_stop)
             summary["serve"] = serve_sec
         # end-of-run report: steady-state percentiles split from compile,
         # chunk shapes actually used, watchdog/prefetch/sink health, and
@@ -1751,6 +1783,11 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         sink.emit("summary", **summary)
         return summary
     finally:
+        if lease is not None:
+            # restore the previous SIGTERM disposition: a later run in
+            # this process must not drain into THIS run's lease (kept
+            # armed until here so the --serve window drains on it too)
+            lease.uninstall()
         if ckpt_mgr is not None:
             # drain + join the checkpoint writer on ANY exit: a restart
             # (run_with_recovery) must never begin its restore with a
@@ -1949,6 +1986,14 @@ def _validate_serving(config: ExperimentConfig, ex: _Experiment,
         raise ValueError(
             f"--serve-shared-prefix must be >= 0, got "
             f"{config.serve_shared_prefix}")
+    if config.serve_slo_ttft <= 0 or config.serve_slo_itl <= 0:
+        raise ValueError(
+            f"--serve-slo-ttft/--serve-slo-itl must be positive seconds, "
+            f"got {config.serve_slo_ttft}/{config.serve_slo_itl}")
+    if config.serve_queue_cap < 0:
+        raise ValueError(
+            f"--serve-queue-cap must be >= 0 (0 = unbounded admission), "
+            f"got {config.serve_queue_cap}")
     plen = config.serve_prompt_len
     if plen < 1 or plen > test_ds.x.shape[1]:
         raise ValueError(
@@ -1964,7 +2009,8 @@ def _validate_serving(config: ExperimentConfig, ex: _Experiment,
 
 
 def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
-                      test_ds, tracer, total_devices: int) -> dict[str, Any]:
+                      test_ds, tracer, total_devices: int,
+                      should_stop=None) -> dict[str, Any]:
     """--serve N: run a continuous-batching serving window over the
     trained params (serving/SlotKVCache + ContinuousBatcher) and return
     the run report's ``serve`` section.
@@ -1979,8 +2025,17 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
     axis; otherwise it serves replicated.  Greedy decode: like --sample,
     the recorded window is a deterministic function of the final params.
     Engines whose state stacks per-device copies (async/gossip) serve
-    their consensus ``eval_params``, same as evaluation and sampling."""
-    from distributed_tensorflow_tpu.observability import serve_section
+    their consensus ``eval_params``, same as evaluation and sampling.
+
+    SLO observability (round 13): every window runs under an SLOMonitor
+    (``--serve-slo-ttft``/``--serve-slo-itl``, p99 ITL per request) so the
+    serve section always carries ``serve_goodput_under_slo`` and the
+    p50/p95/p99 phase percentiles; ``--serve-queue-cap`` arms the
+    bounded-admission overload mode.  ``should_stop`` is the lease-drain
+    hook: a SIGTERM'd serve window stops admitting, finishes in-flight
+    requests, and its partial section still flushes into the report."""
+    from distributed_tensorflow_tpu.observability import (
+        SLOMonitor, serve_section)
     from distributed_tensorflow_tpu.serving import (
         ContinuousBatcher, Request, SlotKVCache)
 
@@ -2027,7 +2082,10 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
                      slots=config.serve_slots):
         summary = ContinuousBatcher(
             kv, tracer=tracer,
-            prefill_chunk=config.serve_prefill_chunk).run(requests)
+            prefill_chunk=config.serve_prefill_chunk,
+            slo=SLOMonitor(config.serve_slo_ttft, config.serve_slo_itl),
+            queue_cap=config.serve_queue_cap,
+            should_stop=should_stop).run(requests)
     return serve_section(summary, total_devices)
 
 
