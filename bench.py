@@ -1218,7 +1218,9 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, vocab: int = 16384,
 
 def bench_serve(stream: bool = False, trace_path: str | None = None,
                 sweep: bool = False, slo_ttft: float | None = None,
-                slo_itl: float | None = None, queue_cap: int = 0) -> None:
+                slo_itl: float | None = None, queue_cap: int = 0,
+                kv_dtype: str | None = None, draft: str | None = None,
+                draft_k: int | None = None) -> None:
     """Serving throughput + latency percentiles of the continuous-batching
     engine (distributed_tensorflow_tpu/serving/) against the static-batch
     restart-per-``generate`` baseline, on the SAME synthetic open-loop
@@ -1246,9 +1248,18 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
     rate.  ``--stream`` exercises the per-token streaming delivery hook
     (tokens reach the host every decode iteration in all modes; --stream
     additionally counts deliveries through the callback) and emits the
-    same key set.  Smoke runs shrink the workload via BENCH_SERVE_* env
-    vars (model dims, slots, request count, arrival rate, chunk/pool
-    shape) exactly like BENCH_PER_CHIP_BATCH."""
+    same key set.  Round 14: ``--serve-kv-dtype`` (BENCH_SERVE_KV_DTYPE)
+    stores the production windows' KV table in bf16 or int8 — with int8
+    a model-dtype comparison window runs on the SAME seeded trace and
+    the line carries serve_kv_dtype / serve_kv_bytes_per_slot + the
+    bytes ratio and greedy-token agreement — and ``--serve-draft``
+    (BENCH_SERVE_DRAFT, 'self' or a GPT size spec) turns the production
+    windows speculative (draft-k → verify-1; serve_accept_rate + the
+    proposed/accepted ledger ride the line; the monolithic/static
+    baselines stay non-speculative on the same trace).  Smoke runs
+    shrink the workload via BENCH_SERVE_* env vars (model dims, slots,
+    request count, arrival rate, chunk/pool shape) exactly like
+    BENCH_PER_CHIP_BATCH."""
     import jax
     import jax.numpy as jnp
 
@@ -1295,6 +1306,15 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
         slo_itl = float(env("BENCH_SERVE_SLO_ITL", "0.25"))
     sweep_points = int(env("BENCH_SERVE_SWEEP_POINTS", "6"))
     sweep_factor = float(env("BENCH_SERVE_SWEEP_FACTOR", "2.0"))
+    # round 14: KV storage dtype for the production windows (int8 = int8
+    # payload + per-vector f32 scales; with it set, a model-dtype
+    # comparison window runs on the SAME seeded trace) and speculative
+    # decoding ('self' or a draft GPT size spec; baselines stay
+    # non-speculative on the same trace)
+    kv_dtype = kv_dtype or env("BENCH_SERVE_KV_DTYPE", "") or None
+    draft = draft or env("BENCH_SERVE_DRAFT", "") or None
+    if draft_k is None:
+        draft_k = int(env("BENCH_SERVE_DRAFT_K", "4"))
 
     mesh = with_backend_retry(meshlib.create_mesh)
     n = mesh.shape[meshlib.DATA_AXIS]
@@ -1347,14 +1367,48 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                         arrival_s=float(arrivals[i] * rate_scale))
                 for i in range(n_requests)]
 
-    # two tables, one workload: `kv` runs the round-10 production path
-    # (chunk-resumable prefill + prefix pool); `kv_base` runs the
-    # monolithic/no-cache programs for the chunked-vs-monolithic and
-    # continuous-vs-static comparisons on the SAME seeded trace
+    # tables, one workload: `kv` runs the production path (chunk-resumable
+    # prefill + prefix pool, at --serve-kv-dtype storage); `kv_base` runs
+    # the monolithic/no-cache programs for the chunked-vs-monolithic and
+    # continuous-vs-static comparisons on the SAME seeded trace; with a
+    # non-default --serve-kv-dtype, `kv_cmp` is the model-dtype twin of
+    # the production config for the bf16-vs-int8 same-trace comparison
+    resolved_kv_dtype = None
+    if kv_dtype:
+        resolved_kv_dtype = ("int8" if kv_dtype == "int8"
+                             else jnp.dtype(jnp.bfloat16))
     kv = SlotKVCache(model, params, slots, mesh=mesh,
+                     kv_dtype=resolved_kv_dtype,
                      prefix_cache_blocks=cache_blocks,
                      prefix_block=prefix_block)
     kv_base = SlotKVCache(model, params, slots, mesh=mesh)
+    kv_cmp = None
+    if resolved_kv_dtype is not None:
+        kv_cmp = SlotKVCache(model, params, slots, mesh=mesh,
+                             prefix_cache_blocks=cache_blocks,
+                             prefix_block=prefix_block)
+    # speculative decoding: the draft's own full-precision table, in slot
+    # lockstep with `kv` (windows evict everything on exit, so sharing
+    # one draft table across windows is safe like sharing `kv`)
+    draft_kv = None
+    if draft:
+        from distributed_tensorflow_tpu.utils.harness import (
+            parse_draft_config)
+
+        overrides = parse_draft_config(draft)
+        if overrides is None:
+            draft_model, draft_params = model, params
+        else:
+            draft_model = create_model(
+                "gpt", num_classes=vocab, max_len=max_len,
+                dropout_rate=0.0, dtype=jnp.bfloat16, **overrides)
+            dummy = jnp.zeros((1, prompt_len), jnp.int32)
+            draft_params = with_backend_retry(
+                lambda: jax.jit(lambda k: draft_model.init(
+                    k, dummy, train=False))(
+                        jax.random.key(1))["params"], "draft init")
+        draft_kv = SlotKVCache(draft_model, draft_params, slots,
+                               mesh=mesh)
 
     def _warm():
         # compile the decode step + every prefill bucket AND chunk bucket
@@ -1372,32 +1426,51 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
         while chunk and b < chunk:
             buckets.append(b)
             b *= 2
-        for blen in sorted(set(buckets)):
-            slot, _ = kv.begin_insert(
-                rng.integers(0, vocab, blen).astype(np.int32))
-            while kv.prefill_chunk(slot, chunk or None) is None:
-                pass
-            kv.advance()
-            kv.evict(slot)
-        if not chunk:
-            for plen in sorted(set(lens)):
-                slot, _ = kv.insert(prompts[lens.index(plen)])
-                kv.advance()
-                kv.evict(slot)
-        if cache_blocks:
-            # force one pool HIT so the block-restore program compiles
-            # here too (the read side compiled when the admissions above
-            # pooled their blocks; the write side only runs on a hit —
-            # without this, the first shared-prefix request of window 1
-            # pays its XLA compile inside the measured TTFT)
-            longest = max(prompts, key=len)
-            for _ in range(2):
-                slot, _ = kv.begin_insert(longest)
-                while kv.prefill_chunk(slot, chunk or None) is None:
+        for table in [kv] + ([kv_cmp] if kv_cmp is not None else []):
+            for blen in sorted(set(buckets)):
+                slot, _ = table.begin_insert(
+                    rng.integers(0, vocab, blen).astype(np.int32))
+                while table.prefill_chunk(slot, chunk or None) is None:
                     pass
-                kv.advance()
-                kv.evict(slot)
-        kv.reset_prefix_cache()   # timed windows start with a cold pool
+                table.advance()
+                table.evict(slot)
+            if not chunk:
+                for plen in sorted(set(lens)):
+                    slot, _ = table.insert(prompts[lens.index(plen)])
+                    table.advance()
+                    table.evict(slot)
+            if cache_blocks:
+                # force one pool HIT so the block-restore program
+                # compiles here too (the read side compiled when the
+                # admissions above pooled their blocks; the write side
+                # only runs on a hit — without this, the first
+                # shared-prefix request of window 1 pays its XLA compile
+                # inside the measured TTFT)
+                longest = max(prompts, key=len)
+                for _ in range(2):
+                    slot, _ = table.begin_insert(longest)
+                    while table.prefill_chunk(slot, chunk or None) is None:
+                        pass
+                    table.advance()
+                    table.evict(slot)
+            table.reset_prefix_cache()  # timed windows start cold
+        if draft_kv is not None:
+            # speculative path: throwaway spec windows compile the
+            # draft's decode step, its prefill buckets, and EVERY verify
+            # width a round can hit — _spec_k shrinks k_eff to
+            # remaining-budget/capacity, so widths 2..draft_k+1 all
+            # occur as requests wind down; compiling one inside a timed
+            # window would inflate that window's tail percentiles (the
+            # first-compile-inside-measurement bug class the prefix-pool
+            # warm already guards)
+            spec_warm = ContinuousBatcher(
+                kv, mode="continuous", prefill_chunk=chunk,
+                draft_kv=draft_kv, draft_k=draft_k)
+            for m in range(2, draft_k + 3):
+                spec_warm.run([Request(rid=-m, prompt=prompts[m % 2],
+                                       max_new_tokens=m,
+                                       arrival_s=0.0)])
+            kv.reset_prefix_cache()
         note(f"warm: production {kv.compiled_programs()}, "
              f"baseline {kv_base.compiled_programs()}")
 
@@ -1415,7 +1488,8 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
         vals = [v for v in vals if v is not None]
         return statistics.median(vals) if vals else None
 
-    def window(mode, table, budget, label, rate_scale=1.0, cap=0):
+    def window(mode, table, budget, label, rate_scale=1.0, cap=0,
+               spec=False, sink=None):
         def _one(rep):
             delivered[0] = 0   # per-window count: the emitted number must
             if table.prefix_cache_blocks:
@@ -1423,12 +1497,19 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                 # deterministic property of the workload, not of how many
                 # windows ran before this one
                 table.reset_prefix_cache()
+            deliver = on_token
+            if sink is not None:
+                # token-collecting window (the kv-dtype greedy-agreement
+                # comparison): per-rid streams instead of the counter
+                deliver = (lambda rid, tok:
+                           sink.setdefault(rid, []).append(tok))
             # one SLOMonitor per window (goodput is a per-window number)
             batcher = ContinuousBatcher(
                 table, tracer=tracer, mode=mode, prefill_chunk=budget,
-                slo=SLOMonitor(slo_ttft, slo_itl), queue_cap=cap)
+                slo=SLOMonitor(slo_ttft, slo_itl), queue_cap=cap,
+                draft_kv=draft_kv if spec else None, draft_k=draft_k)
             summary = serve_section(batcher.run(workload(rate_scale),
-                                                on_token=on_token), n)
+                                                on_token=deliver), n)
             if stream:         # describe ONE window, not every mode×repeat
                 summary["tokens_delivered"] = delivered[0]
             note(f"{label} window {rep}: "
@@ -1457,7 +1538,7 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                 r = rate * sweep_factor ** k
                 wins = measure_windows(
                     window("continuous", kv, chunk, f"sweep@{r:g}/s",
-                           rate_scale=rate / r),
+                           rate_scale=rate / r, spec=True),
                     sweep_repeats, f"sweep@{r:g}", partial_errors)
                 if not wins:
                     break
@@ -1498,7 +1579,8 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                 over_wins = measure_windows(
                     window("continuous", kv, chunk,
                            f"overload@{over_rate:g}/s",
-                           rate_scale=rate / over_rate, cap=cap),
+                           rate_scale=rate / over_rate, cap=cap,
+                           spec=True),
                     sweep_repeats, "overload", partial_errors)
                 if over_wins:
                     over = over_wins[0]
@@ -1541,7 +1623,10 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                        "prefix_cache_blocks": cache_blocks,
                        "prefix_block": prefix_block,
                        "shared_prefix": shared_len,
-                       "long_every": long_every},
+                       "long_every": long_every,
+                       "kv_dtype": kv.kv_dtype,
+                       "draft": draft,
+                       "draft_k": draft_k if draft else None},
             "device": device_kind,
             "n_devices": n,
             "synthetic": True,
@@ -1556,9 +1641,10 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
 
     try:
         # production path: chunked prefill + prefix pool (+ the bounded-
-        # admission cap when --serve-queue-cap is set)
+        # admission cap when --serve-queue-cap is set; speculative when
+        # --serve-draft is; at --serve-kv-dtype storage)
         cont = measure_windows(window("continuous", kv, chunk, "serve",
-                                      cap=queue_cap),
+                                      cap=queue_cap, spec=True),
                                repeats, "serve", partial_errors)
         if not cont:
             raise RuntimeError(f"no serve window completed: "
@@ -1573,6 +1659,43 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
         stat = measure_windows(window("static", kv_base, 0,
                                       "serve_static"),
                                repeats, "serve_static", partial_errors)
+        # --serve-kv-dtype: the model-dtype twin of the production config
+        # on the SAME seeded trace (BASELINE same-trace rule) — one
+        # token-collecting window each side gives the greedy-agreement
+        # number alongside the bytes/latency comparison
+        kv_cmp_line = None
+        if kv_cmp is not None:
+            prod_sink: dict[int, list[int]] = {}
+            base_sink: dict[int, list[int]] = {}
+            prod_wins = measure_windows(
+                window("continuous", kv, chunk, "serve_kv_prod",
+                       spec=True, sink=prod_sink),
+                1, "serve_kv_prod", partial_errors)
+            cmp_wins = measure_windows(
+                window("continuous", kv_cmp, chunk, "serve_kv_baseline",
+                       sink=base_sink),
+                1, "serve_kv_baseline", partial_errors)
+            if prod_wins and cmp_wins:
+                shared = sorted(set(prod_sink) & set(base_sink))
+                matched = sum(prod_sink[r] == base_sink[r]
+                              for r in shared)
+                cmp_w = cmp_wins[0]
+                prod_bytes = prod_wins[0]["serve_kv_bytes_per_slot"]
+                cmp_bytes = cmp_w["serve_kv_bytes_per_slot"]
+                kv_cmp_line = {
+                    "kv_dtype": cmp_w["serve_kv_dtype"],
+                    "serve_kv_bytes_per_slot": cmp_bytes,
+                    "tokens_per_sec": cmp_w["serve_tokens_per_sec"],
+                    "itl_p95_s": cmp_w["serve_itl_p95_s"],
+                    "ttft_p50_s": cmp_w["serve_ttft_p50_s"],
+                    # stored-bytes ratio (production / model-dtype) and
+                    # the fraction of requests whose greedy streams agree
+                    # token-for-token — the tolerance-based acceptance
+                    "kv_bytes_ratio": (round(prod_bytes / cmp_bytes, 4)
+                                       if cmp_bytes else None),
+                    "greedy_token_match": (matched / len(shared)
+                                           if shared else None),
+                }
     finally:
         # drain the span sink even when every window died — the spans up
         # to the failure are exactly the ones worth keeping
@@ -1596,7 +1719,12 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                   # it the day a cap or a regression sheds)
                   "serve_queue_wait_p50_s", "serve_queue_wait_p95_s",
                   "serve_queue_wait_p99_s",
-                  "serve_goodput_under_slo", "serve_shed_rate")
+                  "serve_goodput_under_slo", "serve_shed_rate",
+                  # round 14: KV-table bytes per slot (the --serve-kv-
+                  # dtype capacity number) + the speculative-decode
+                  # accept rate (None without a draft; tokens/sec stays
+                  # emitted-tokens-only either way)
+                  "serve_kv_bytes_per_slot", "serve_accept_rate")
     line = {k: med(cont, k) for k in serve_keys}
     rps = line["serve_requests_per_sec_per_chip"]
     static_rps = med(stat, "serve_requests_per_sec_per_chip")
@@ -1620,6 +1748,14 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
         "serve_prefill_chunks": med(cont, "prefill_chunks"),
         "serve_shed_requests": med(cont, "shed_requests"),
         "serve_queue_depth_p95": med(cont, "queue_depth_p95"),
+        # round 14: KV storage attribution (environment-style — the dtype
+        # is part of the number) + the speculative ledger of the FIRST
+        # production window (counts, not rates — medians would tear the
+        # conservation identity) and the same-trace model-dtype baseline
+        # when --serve-kv-dtype is set
+        "serve_kv_dtype": (cont[0].get("serve_kv_dtype")),
+        "speculative": cont[0].get("speculative"),
+        "kv_baseline": kv_cmp_line,
         "slo": {"ttft_s": slo_ttft, "itl_s": slo_itl, "quantile": 0.99,
                 "attainment": med(cont, None,
                                   vals=[(w.get("slo") or {}).get(
@@ -1662,7 +1798,9 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                    "shared_prefix": shared_len,
                    "long_every": long_every, "long_len": long_len,
                    "slo_ttft_s": slo_ttft, "slo_itl_s": slo_itl,
-                   "queue_cap": queue_cap},
+                   "queue_cap": queue_cap,
+                   "kv_dtype": kv.kv_dtype,
+                   "draft": draft, "draft_k": draft_k if draft else None},
         "device": device_kind,
         "n_devices": n,
         "synthetic": True,
@@ -1740,6 +1878,28 @@ def main() -> None:
                         "backlog at N, shed the excess with 429 "
                         "accounting (the --sweep overload window uses "
                         "this cap, defaulting to the slot count)")
+    p.add_argument("--serve-kv-dtype", default=None,
+                   choices=["bfloat16", "bf16", "int8"], metavar="DTYPE",
+                   help="--serve: KV slot-table storage dtype for the "
+                        "production windows (default BENCH_SERVE_KV_DTYPE "
+                        "or the model's bf16).  With int8 the line also "
+                        "runs a model-dtype (bf16) comparison window on "
+                        "the SAME seeded trace (BASELINE same-trace "
+                        "rule) and emits serve_kv_dtype / "
+                        "serve_kv_bytes_per_slot + the bytes ratio and "
+                        "greedy-token agreement vs that baseline")
+    p.add_argument("--serve-draft", default=None, metavar="SPEC",
+                   help="--serve: speculative decoding for the "
+                        "production windows — 'self' (draft = the bench "
+                        "model + params) or 'hidden=..,layers=..' GPT "
+                        "size overrides (default BENCH_SERVE_DRAFT).  "
+                        "The monolithic/static baselines stay "
+                        "non-speculative on the same trace; the line "
+                        "gains serve_accept_rate + the speculative "
+                        "ledger")
+    p.add_argument("--serve-draft-k", type=int, default=None, metavar="K",
+                   help="--serve-draft: draft tokens proposed per verify "
+                        "round (default BENCH_SERVE_DRAFT_K or 4)")
     p.add_argument("--steps", type=int, default=100,
                    help="--stream: measured steps per repetition (the test "
                         "suite's smoke invocation shrinks this, plus "
@@ -1817,7 +1977,10 @@ def main() -> None:
             bench_serve(stream=args.stream, trace_path=args.trace,
                         sweep=args.sweep, slo_ttft=args.serve_slo_ttft,
                         slo_itl=args.serve_slo_itl,
-                        queue_cap=args.serve_queue_cap)
+                        queue_cap=args.serve_queue_cap,
+                        kv_dtype=args.serve_kv_dtype,
+                        draft=args.serve_draft,
+                        draft_k=args.serve_draft_k)
         elif mode == "stream":
             bench_stream(steps=max(args.steps, 1),
                          grad_compression=args.grad_compression,

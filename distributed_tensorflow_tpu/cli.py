@@ -189,13 +189,36 @@ def build_parser() -> argparse.ArgumentParser:
                    help="--serve: prompt tokens taken from the test "
                         "split per request")
     p.add_argument("--serve-kv-dtype", default=None,
-                   choices=["float32", "f32", "bfloat16", "bf16"],
+                   choices=["float32", "f32", "bfloat16", "bf16", "int8"],
                    help="--serve: KV slot-table storage dtype (default: "
                         "the model's dtype).  bfloat16 halves the KV "
                         "memory per slot — double the serving slots per "
                         "chip at equal HBM; greedy tokens stay oracle-"
-                        "exact on the shipped models and the dtype is "
-                        "surfaced in the serve report section")
+                        "exact on the shipped models.  int8 halves "
+                        "bf16's payload again (int8 K/V + one f32 "
+                        "max-abs scale per written vector, dequantized "
+                        "on the attention read) — token parity vs the "
+                        "bf16 oracle is tolerance-based, not bitwise.  "
+                        "The dtype and serve_kv_bytes_per_slot ride the "
+                        "serve report section (gated by `analyze diff`)")
+    p.add_argument("--serve-draft-config", default=None, metavar="SPEC",
+                   help="--serve: speculative decoding — a draft GPT "
+                        "proposes --serve-draft-k tokens per live slot, "
+                        "the served model verifies all k+1 positions in "
+                        "ONE batched step, and greedy acceptance keeps "
+                        "the emitted stream BITWISE identical to non-"
+                        "speculative decode.  SPEC is 'self' (draft = "
+                        "the served model + params; accept rate 1) or "
+                        "'hidden=64,layers=1,...' GPT size overrides "
+                        "(vocab/max_len inherited, fresh-initialized "
+                        "from --seed).  Default off: the pre-round-14 "
+                        "programs, byte-identical")
+    p.add_argument("--serve-draft-k", type=int, default=4, metavar="K",
+                   help="--serve-draft-config: draft tokens proposed per "
+                        "verify round (capped per round by slot capacity "
+                        "and remaining request budgets).  The serve "
+                        "section carries serve_accept_rate + the "
+                        "proposed/accepted/rejected ledger")
     p.add_argument("--serve-prefill-chunk", type=int, default=0,
                    metavar="T",
                    help="--serve: chunked prefill token budget (Sarathi-"
@@ -588,6 +611,8 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         serve_slo_ttft=args.serve_slo_ttft,
         serve_slo_itl=args.serve_slo_itl,
         serve_queue_cap=args.serve_queue_cap,
+        serve_draft_config=args.serve_draft_config,
+        serve_draft_k=args.serve_draft_k,
     )
     summary = run(config)  # run() itself wraps recovery when max_restarts>0
     print(json.dumps(summary))

@@ -43,6 +43,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel import compression
 from distributed_tensorflow_tpu.parallel import mesh as meshlib
 from distributed_tensorflow_tpu.parallel.ring_attention import (
     dense_attention, ring_attention, ring_flash_attention,
@@ -99,6 +100,12 @@ class CausalSelfAttention(nn.Module):
                                # writes are per-row scatters, and validity
                                # is length-driven, so one compiled decode
                                # step advances slots of any age
+    kv_quant: bool = False     # int8 KV storage (decode_slots only): K/V
+                               # cached as int8 with one f32 max-abs scale
+                               # per written vector (slot × position ×
+                               # head; parallel/compression.py channel
+                               # quantizer), dequantized on the attention
+                               # read — the stored table is what shrinks
 
     @nn.compact
     def __call__(self, x, pos=None):
@@ -150,10 +157,15 @@ class CausalSelfAttention(nn.Module):
             # decode-API users get a sticky ``cache['overflow']`` flag
             # (ADVICE r3: the silent clamp corrupted continuations with no
             # signal) — check it after the decode loop.
-            if x.shape[1] != 1:
+            if x.shape[1] != 1 and not self.decode_slots:
                 raise ValueError(
                     f"decode mode consumes one token per call, got "
                     f"sequence length {x.shape[1]}")
+            if self.kv_quant and not self.decode_slots:
+                raise ValueError(
+                    "kv_quant=True is a slot-table storage mode: it "
+                    "requires decode_slots=True (the serving engine owns "
+                    "the quantized table)")
             import jax
 
             b = x.shape[0]
@@ -177,22 +189,46 @@ class CausalSelfAttention(nn.Module):
                 # at p) — the per-token math is identical either way,
                 # which is what makes chunked admission bitwise equal to
                 # monolithic admission (tests/test_serving.py).
+                # TOKEN-BLOCK CONTRACT (speculative verify): the same
+                # mode also accepts a (B, L) block of L consecutive
+                # tokens per slot — all L K/V vectors scatter into the
+                # cache first, then each query attends under a PER-QUERY
+                # validity mask (positions ≤ its own), so position j's
+                # logits condition on exactly the block prefix 0..j plus
+                # the cache: one batched step scores k draft tokens + the
+                # committed token, and rejected positions are invalidated
+                # by length bookkeeping alone
+                # (serving/kv_cache.py verify_block).
                 if pos is None:
                     raise ValueError(
                         "decode_slots=True needs per-slot positions "
                         "(B, 1) — the serving engine passes the slot "
                         "length vector")
                 ready = self.has_variable("cache", "cached_key")
+                store = jnp.int8 if self.kv_quant else self.dtype
                 ck = self.variable(
                     "cache", "cached_key", jnp.zeros,
-                    (b, self.max_len, kvh, head_dim), self.dtype)
+                    (b, self.max_len, kvh, head_dim), store)
                 cv = self.variable(
                     "cache", "cached_value", jnp.zeros,
-                    (b, self.max_len, kvh, head_dim), self.dtype)
+                    (b, self.max_len, kvh, head_dim), store)
+                if self.kv_quant:
+                    # one f32 max-abs scale per written K/V vector (slot
+                    # × position × head), stored alongside the table in
+                    # the same cache pytree — the slot dim shards
+                    # identically (parallel/mesh.kv_slot_sharding handles
+                    # the 3-dim leaf), and a write never requantizes
+                    # older entries
+                    ks = self.variable(
+                        "cache", "key_scale", jnp.zeros,
+                        (b, self.max_len, kvh), jnp.float32)
+                    vs = self.variable(
+                        "cache", "value_scale", jnp.zeros,
+                        (b, self.max_len, kvh), jnp.float32)
                 if not ready:
                     out = dense_attention(q, widen(k), widen(v),
                                           causal=True)
-                else:
+                elif x.shape[1] == 1 and not self.kv_quant:
                     idx = pos[:, 0]
                     rows = jnp.arange(b)
                     # cast to the table's dtype: the serving engine may
@@ -208,6 +244,36 @@ class CausalSelfAttention(nn.Module):
                              <= idx[:, None]).astype(self.dtype)
                     out = dense_attention(
                         q, widen(ck.value), widen(cv.value),
+                        causal=False, kv_mask=valid)
+                else:
+                    # token-block write (speculative verify) and/or int8
+                    # storage: scatter every position's K/V (+ scale),
+                    # then attend each query against the table under its
+                    # own position mask — the L == 1 case of this path is
+                    # the same math as the branch above
+                    idx = pos                       # (B, L)
+                    rows = jnp.arange(b)[:, None]
+                    if self.kv_quant:
+                        qk, sk = compression.int8_channel_encode(k)
+                        qv, sv = compression.int8_channel_encode(v)
+                        ck.value = ck.value.at[rows, idx].set(qk)
+                        cv.value = cv.value.at[rows, idx].set(qv)
+                        ks.value = ks.value.at[rows, idx].set(sk)
+                        vs.value = vs.value.at[rows, idx].set(sv)
+                        keys = compression.int8_channel_decode(
+                            ck.value, ks.value, self.dtype)
+                        vals = compression.int8_channel_decode(
+                            cv.value, vs.value, self.dtype)
+                    else:
+                        ck.value = ck.value.at[rows, idx].set(
+                            k.astype(ck.value.dtype))
+                        cv.value = cv.value.at[rows, idx].set(
+                            v.astype(cv.value.dtype))
+                        keys, vals = ck.value, cv.value
+                    valid = (jnp.arange(self.max_len)[None, None, :]
+                             <= idx[:, :, None]).astype(self.dtype)
+                    out = dense_attention(
+                        q, widen(keys), widen(vals),
                         causal=False, kv_mask=valid)
                 out = out.reshape(out.shape[:-2]
                                   + (self.heads * head_dim,))
@@ -303,6 +369,7 @@ class GPTBlock(nn.Module):
     moe_capacity_factor: float = 1.25
     partition_experts: bool = False
     decode_slots: bool = False   # serving slot-table decode (see attention)
+    kv_quant: bool = False       # int8 KV storage (see attention)
 
     @nn.compact
     def __call__(self, x, train: bool = False, pos=None):
@@ -310,7 +377,8 @@ class GPTBlock(nn.Module):
         y = CausalSelfAttention(self.hidden, self.heads, self.attention_impl,
                                 self.seq_axis, tp, self.decode, self.max_len,
                                 self.rope, self.kv_heads, self.dtype,
-                                decode_slots=self.decode_slots)(
+                                decode_slots=self.decode_slots,
+                                kv_quant=self.kv_quant)(
                                     nn.LayerNorm(dtype=self.dtype)(x), pos)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
@@ -391,6 +459,10 @@ class GPTLM(nn.Module):
                                  # per-slot ``positions`` and owns the
                                  # length/active bookkeeping; one compiled
                                  # decode step advances slots of any age
+    kv_quant: bool = False       # int8 KV storage with per-vector f32
+                                 # scales (decode_slots only; --serve-kv-
+                                 # dtype int8 — the stored table is ~¼ of
+                                 # f32, ~½ of bf16)
 
     causal_lm = True  # read by engines/harness to select the LM data layout
 
@@ -502,6 +574,7 @@ class GPTLM(nn.Module):
                           self.dtype, self.moe_experts, self.moe_top_k,
                           self.moe_capacity_factor, self.partition_experts,
                           decode_slots=self.decode_slots,
+                          kv_quant=self.kv_quant,
                           name=f"GPTBlock_{i}")(
                               x, train,
                               pos if (rope or self.decode_slots) else None)

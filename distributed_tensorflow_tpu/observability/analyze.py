@@ -458,6 +458,16 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     ("serve_max_goodput_under_slo", "higher"),
     ("serve_knee_rate_per_s", "higher"),
     ("serve_shed_rate", "lower"),
+    # raw decode speed (round 14): the speculative-decode accept rate is
+    # draft-token efficiency — fewer accepts at the same draft config is
+    # a regression in verify-step yield (BASELINE.md: cross-run
+    # comparisons must state the draft config, the rate is workload-
+    # dependent) — and the stored KV bytes per serving slot are the
+    # capacity-per-chip number int8/bf16 storage exists to shrink.
+    # serve_tokens_per_sec (the gated speculative headline, emitted
+    # tokens only) is already listed above.
+    ("serve_accept_rate", "higher"),
+    ("serve_kv_bytes_per_slot", "lower"),
 )
 
 
@@ -528,7 +538,10 @@ def _value_direction(report: dict[str, Any]) -> str:
     if any(s in probe for s in ("per_sec", "per sec", "/sec", "/s ")):
         return "higher"
     if any(s in probe for s in ("_ms", " ms", "ms/", "_s ", "seconds_per",
-                                "sec_per", "s/step", "latency")):
+                                "sec_per", "s/step", "latency",
+                                # byte-valued headlines (kv_bytes_per_slot
+                                # class): smaller footprint is the win
+                                "byte")):
         return "lower"
     return "higher"
 

@@ -226,6 +226,33 @@ def _int8_decode(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
+def int8_channel_encode(x: jax.Array,
+                        axis: int = -1) -> tuple[jax.Array, jax.Array]:
+    """(q, scale): max-abs scale reduced over ``axis`` (one f32 scale per
+    remaining index), values rounded TO NEAREST into int8 [-127, 127].
+
+    The serving KV cache's quantizer (serving/kv_cache.py kv_dtype=int8):
+    each written K/V vector gets its own scale — per slot × position ×
+    head, reduced over head_dim — so a write never has to requantize
+    older cache entries, and decoding is deterministic (no stochastic
+    rounding: a served token stream must be a pure function of the
+    params + prompt, the same rule as greedy sampling)."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x32), axis=axis) / 127.0
+    scale = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny)
+    q = jnp.clip(jnp.round(x32 / jnp.expand_dims(scale, axis)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def int8_channel_decode(q: jax.Array, scale: jax.Array, dtype,
+                        axis: int = -1) -> jax.Array:
+    """Inverse of :func:`int8_channel_encode` (broadcasts the scale back
+    over ``axis``)."""
+    return (q.astype(jnp.float32)
+            * jnp.expand_dims(scale, axis).astype(jnp.float32)).astype(dtype)
+
+
 class Int8Codec(GradCodec):
     """Per-leaf scale + stochastic rounding to int8; f32 master values
     preserved (only the exchanged copy is quantized).

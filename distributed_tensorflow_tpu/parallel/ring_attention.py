@@ -47,7 +47,10 @@ def dense_attention(q, k, v, causal: bool = False, scale: float | None = None,
                     kv_mask=None, prob_fn=None):
     """Single-device reference attention (test oracle and small-seq path).
 
-    ``kv_mask``: optional (B, Lk) key-validity mask; masked keys get NEG_INF.
+    ``kv_mask``: optional key-validity mask; masked keys get NEG_INF.
+    (B, Lk) applies per batch row to every query; (B, Lq, Lk) applies per
+    QUERY — the multi-position slot-decode verify step needs each query in
+    a token block to see only cache positions at or before its own.
     ``prob_fn``: optional transform of the post-softmax probabilities —
     the hook for attention-probability dropout (blockwise ring attention
     cannot support it; flash-style implementations conventionally drop it).
@@ -60,7 +63,9 @@ def dense_attention(q, k, v, causal: bool = False, scale: float | None = None,
         kpos = jnp.arange(lk)[None, :]
         s = jnp.where(qpos >= kpos, s, NEG_INF)
     if kv_mask is not None:
-        s = jnp.where(kv_mask[:, None, None, :] > 0, s, NEG_INF)
+        m = (kv_mask[:, None, :, :] if kv_mask.ndim == 3
+             else kv_mask[:, None, None, :])
+        s = jnp.where(m > 0, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     if prob_fn is not None:
         p = prob_fn(p)

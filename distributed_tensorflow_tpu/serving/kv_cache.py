@@ -44,6 +44,16 @@ monolithic prefill (each token's forward depends only on cache positions
 below its own, all written by earlier chunks), and a prefix-cache hit is
 bitwise-identical to recomputation (the pooled KV is a byte copy of what
 the cold prefill would write).
+
+Round 14 adds the two raw-decode-speed levers (ROADMAP item 3):
+``verify_block``/``commit_block``/``rewind`` — the speculative-decode
+device step (one batched program scores a (slots, k+1) token block;
+acceptance/rollback is length bookkeeping alone, the same
+validity-is-length-driven argument as chunk resume) — and
+``kv_dtype='int8'`` — K/V stored int8 with one f32 max-abs scale per
+written vector, the scale leaves riding the same sharded cache pytree
+(``kv_bytes_per_slot`` is the capacity number; token parity vs the bf16
+oracle is tolerance-based, the one serving feature with that caveat).
 """
 
 from __future__ import annotations
@@ -115,11 +125,22 @@ class SlotKVCache:
         self.temperature = float(temperature)
         self.prefill_bucket = int(prefill_bucket)
         self.mesh = mesh
+        # --serve-kv-dtype int8: the model stores K/V as int8 with one f32
+        # max-abs scale per written vector (models/gpt.py kv_quant) — the
+        # scale leaves ride the SAME cache pytree, so the slot dim shards
+        # over 'data' exactly like the payload.  Quantize on write,
+        # dequantize on the attention read; token parity vs the bf16
+        # oracle is tolerance-based (greedy-token agreement), not bitwise.
+        self.quantized = False
+        if kv_dtype is not None:
+            kv_dtype = jnp.dtype(kv_dtype)
+            self.quantized = kv_dtype == jnp.dtype(jnp.int8)
         keep_tp = (mesh is not None and model.partition_model
                    and meshlib.MODEL_AXIS in mesh.axis_names)
         self.dm = model.clone(decode=True, decode_slots=True,
                               attention_impl="dense",
-                              partition_model=keep_tp, dropout_rate=0.0)
+                              partition_model=keep_tp, dropout_rate=0.0,
+                              kv_quant=self.quantized)
         self._rng = rng if rng is not None else jax.random.key(0)
 
         # zero slot cache from an abstract init — zeros-from-shape IS the
@@ -129,23 +150,26 @@ class SlotKVCache:
             lambda: self.dm.init(jax.random.key(0), dummy, train=False,
                                  positions=dummy))["cache"]
         cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
-        if kv_dtype is not None:
-            # --serve-kv-dtype: store the K/V table narrower than the
-            # model computes (bf16 halves KV memory → double the slots per
-            # chip).  The model's slot-scatter writes cast to the table's
-            # dtype (models/gpt.py) and the attention read promotes back,
-            # so the decode program stays the one compiled step.
-            kv_dtype = jnp.dtype(kv_dtype)
+        if kv_dtype is not None and not self.quantized:
+            # --serve-kv-dtype bfloat16: store the K/V table narrower than
+            # the model computes (bf16 halves KV memory → double the slots
+            # per chip).  The model's slot-scatter writes cast to the
+            # table's dtype (models/gpt.py) and the attention read
+            # promotes back, so the decode program stays the one compiled
+            # step.  (int8 needs no cast here — the kv_quant model
+            # already initializes int8 payload + f32 scale leaves.)
             cache = jax.tree.map(
                 lambda t: t.astype(kv_dtype)
                 if jnp.issubdtype(t.dtype, jnp.floating) else t, cache)
-        # the table's actual storage dtype (first float leaf — the K/V
-        # buffers), surfaced in the serve report section
-        self.kv_dtype = next(
+        # the table's actual storage dtype, surfaced in the serve report
+        # section (for int8 the first FLOAT leaf is a scale, so the name
+        # is pinned explicitly; otherwise it is the K/V buffer dtype)
+        self.kv_dtype = "int8" if self.quantized else next(
             (str(leaf.dtype) for leaf in jax.tree.leaves(cache)
              if jnp.issubdtype(leaf.dtype, jnp.floating)), "float32")
 
         self._vec_sharding = None
+        self._blk_sharding = None
         if mesh is not None:
             dp = mesh.shape.get(meshlib.DATA_AXIS, 1)
             if self.slots % dp:
@@ -157,6 +181,7 @@ class SlotKVCache:
                 lambda t: jax.device_put(t, meshlib.kv_slot_sharding(
                     mesh, t.ndim, shard_heads=keep_tp)), cache)
             self._vec_sharding = meshlib.kv_slot_sharding(mesh, 1)
+            self._blk_sharding = meshlib.kv_slot_sharding(mesh, 2)
             # params committed to this mesh are used in place; anything
             # else replicates (the `generate(mesh=...)` placement rule)
             repl = NamedSharding(mesh, P())
@@ -207,6 +232,7 @@ class SlotKVCache:
         self._step = self._build_step()
         self._prefills: dict[int, object] = {}
         self._chunks: dict[int, object] = {}           # chunk-resume prefill
+        self._verifies: dict[int, object] = {}         # speculative verify
         self._read_block = None                        # prefix-pool extract
         self._write_block = None                       # prefix-pool restore
 
@@ -313,24 +339,52 @@ class SlotKVCache:
 
         return jax.jit(chunk, donate_argnums=1)
 
+    def _verify(self, width: int):
+        """Compiled speculative-verify step for one (slots, width) token
+        block: per slot, ``width`` consecutive tokens (the committed
+        pending token + width-1 draft proposals) enter at positions
+        ``length .. length+width-1``; every position's K/V scatters into
+        the cache and every position's logits take their greedy argmax in
+        ONE batched slot-decode-style program (the models/gpt.py
+        token-block contract — each query masked to positions ≤ its own).
+        The host then ACCEPTS the longest draft prefix matching the
+        argmaxes (``commit_block``); rejected positions stay in the
+        buffer but are invalidated by length bookkeeping alone.  Greedy
+        only: greedy acceptance is what makes speculative output bitwise
+        identical to non-speculative decode."""
+        dm = self.dm
+
+        def verify(params, cache, block, lengths):
+            positions = (lengths[:, None]
+                         + jnp.arange(width, dtype=jnp.int32)[None, :])
+            logits, upd = dm.apply(
+                {"params": params, "cache": cache}, block,
+                train=False, positions=positions, mutable=["cache"])
+            return upd["cache"], logits.argmax(-1).astype(block.dtype)
+
+        return jax.jit(verify, donate_argnums=1)
+
     def _block_ops(self):
         """Jitted prefix-pool block copy programs, compiled once each
         (slot/start are traced): ``read`` slices one block of a slot's KV
         out of every cache leaf; ``write`` scatters a pooled block back
         into a (possibly different) slot.  Cache leaves in slot-decode
-        mode are all (slots, max_len, kv_heads, head_dim)."""
+        mode are (slots, max_len, kv_heads, head_dim) K/V buffers plus —
+        under int8 storage — (slots, max_len, kv_heads) scale leaves, so
+        the slices cover whatever trails the (slot, position) dims."""
         blk = self.prefix_block
 
         def read(cache, slot, start):
             return jax.tree.map(
                 lambda t: lax.dynamic_slice(
-                    t, (slot, start, 0, 0),
-                    (1, blk, t.shape[2], t.shape[3])), cache)
+                    t, (slot, start) + (0,) * (t.ndim - 2),
+                    (1, blk) + t.shape[2:]), cache)
 
         def write(cache, entry, slot, start):
             return jax.tree.map(
                 lambda t, e: lax.dynamic_update_slice(
-                    t, e.astype(t.dtype), (slot, start, 0, 0)),
+                    t, e.astype(t.dtype),
+                    (slot, start) + (0,) * (t.ndim - 2)),
                 cache, entry)
 
         return jax.jit(read), jax.jit(write, donate_argnums=0)
@@ -614,12 +668,21 @@ class SlotKVCache:
         for k in self.prefix_stats:
             self.prefix_stats[k] = 0
 
-    def advance(self) -> np.ndarray:
+    def advance(self, only=None) -> np.ndarray:
         """One decode iteration: every ACTIVE slot consumes its last token
         and emits the next one; lengths advance by one.  Returns the
         (slots,) token vector — inactive rows carry their stale token.
-        The jitted step is compiled exactly once per cache shape."""
-        live = self.lengths[self.active]
+        The jitted step is compiled exactly once per cache shape.
+
+        ``only`` restricts the iteration to a (slots,) bool subset of the
+        active slots (the speculative draft's catch-up step: after a
+        fully-accepted round only those slots must consume one more
+        committed token).  Excluded rows keep their token and length —
+        their row still receives a scatter write at its current length,
+        which is invisible (length-driven validity) and overwritten by
+        that slot's next real write, the free-slot-scatter argument."""
+        mask = self.active if only is None else np.asarray(only, np.bool_)
+        live = self.lengths[mask]
         if live.size and int(live.max()) >= self.max_len:
             raise SlotOverflow(
                 f"active slot at length {int(live.max())} would write past "
@@ -629,12 +692,91 @@ class SlotKVCache:
         self.cache, nxt = self._step(
             self.params, self.cache, self._put_vec(self.tokens),
             self._put_vec(self.lengths),
-            self._put_vec(self.active), self._next_rng())
+            self._put_vec(mask), self._next_rng())
         nxt = np.asarray(nxt)
         self._phase_s["decode_s"] += time.perf_counter() - t0
-        self.lengths[self.active] += 1
+        self.lengths[mask] += 1
         self.tokens = nxt.astype(np.int32)
         return nxt
+
+    # ------------------------------------------------- speculative decode
+    def verify_block(self, block) -> np.ndarray:
+        """Score a (slots, width) token block in one batched step and
+        return the (slots, width) per-position greedy argmax tokens.
+
+        Per slot, ``block[s] = [pending_token, d_1, .., d_{width-1}]`` —
+        the committed pending token followed by draft proposals; K/V for
+        all ``width`` positions is written at ``length .. length+width-1``
+        and the returned row ``g`` satisfies: ``g[j]`` is the target's
+        greedy token after consuming ``block[s, :j+1]``.  Greedy
+        acceptance (``commit_block``) then takes the longest prefix with
+        ``d_{j+1} == g[j]`` plus the target's own next token — bitwise
+        what non-speculative decode would have emitted.  Host bookkeeping
+        (lengths/tokens) is NOT touched here: the scheduler owns
+        acceptance, and rejected positions are rolled back by length
+        bookkeeping alone (no KV rewrite)."""
+        if not self.greedy:
+            raise ValueError(
+                "verify_block requires greedy sampling: the exact "
+                "acceptance rule (accept while draft == target argmax) "
+                "only exists for greedy decode")
+        block = np.asarray(block, np.int32)
+        if block.ndim != 2 or block.shape[0] != self.slots:
+            raise ValueError(
+                f"block must be (slots, width) = ({self.slots}, k+1), "
+                f"got {block.shape}")
+        width = int(block.shape[1])
+        live = self.lengths[self.active]
+        if live.size and int(live.max()) + width > self.max_len:
+            raise SlotOverflow(
+                f"verify width {width} at length {int(live.max())} would "
+                f"write past max_len={self.max_len}; the scheduler must "
+                f"cap the draft k by remaining slot capacity")
+        if width not in self._verifies:
+            self._verifies[width] = self._verify(width)
+        blk = jnp.asarray(block)
+        if self._blk_sharding is not None:
+            blk = jax.device_put(blk, self._blk_sharding)
+        t0 = time.perf_counter()
+        self.cache, g = self._verifies[width](
+            self.params, self.cache, blk, self._put_vec(self.lengths))
+        g = np.asarray(g).astype(np.int32)
+        self._phase_s["decode_s"] += time.perf_counter() - t0
+        return g
+
+    def commit_block(self, slot: int, n: int, last_token: int) -> None:
+        """Commit ``n`` verified positions of the last ``verify_block``
+        into ``slot``: lengths advance by ``n`` and ``last_token`` (the
+        target's own token at the acceptance point) becomes the slot's
+        pending token.  This IS the rollback path for rejected draft
+        positions: the verify wrote K/V for the whole block, but validity
+        is length-driven, so advancing by only the accepted count
+        invalidates the rejected tail with no KV rewrite — the slot's
+        next write simply lands over it."""
+        if not self.active[slot]:
+            raise RuntimeError(f"slot {slot} is not active")
+        if n < 1:
+            raise ValueError(f"commit_block needs n >= 1, got {n}")
+        if int(self.lengths[slot]) + n > self.max_len:
+            raise SlotOverflow(
+                f"committing {n} positions at length "
+                f"{int(self.lengths[slot])} exceeds max_len={self.max_len}")
+        self.lengths[slot] += n
+        self.tokens[slot] = int(last_token)
+
+    def rewind(self, slot: int, length: int, token: int) -> None:
+        """Rewind a slot's validity to ``length`` and set its pending
+        token — the DRAFT table's resync after a verify round: positions
+        past ``length`` were speculative writes, invalidated here by
+        length bookkeeping alone.  A rewind can never extend validity."""
+        if not self.active[slot]:
+            raise RuntimeError(f"slot {slot} is not active")
+        if length > int(self.lengths[slot]):
+            raise ValueError(
+                f"rewind cannot extend validity: slot {slot} is at "
+                f"{int(self.lengths[slot])}, asked for {length}")
+        self.lengths[slot] = int(length)
+        self.tokens[slot] = int(token)
 
     def evict(self, slot: int) -> None:
         """Free a slot.  Pure host bookkeeping: stale K/V stays in the
@@ -654,13 +796,28 @@ class SlotKVCache:
         scheduling decision, so dispatch + device wait both land here."""
         return dict(self._phase_s)
 
+    def kv_bytes_per_slot(self) -> int:
+        """Stored KV-table bytes per serving slot: every cache leaf —
+        K/V payload plus, under int8 storage, its f32 scale leaves —
+        divided by the slot count.  THE capacity number behind
+        ``--serve-kv-dtype``: bf16 halves f32; int8 halves bf16's payload
+        again, plus a per-written-vector scale overhead of 4/head_dim
+        (the serve section carries it as ``serve_kv_bytes_per_slot``,
+        gated lower-is-better by `analyze diff`)."""
+        total = sum(int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+                    for leaf in jax.tree.leaves(self.cache))
+        return total // self.slots
+
     def compiled_programs(self) -> dict[str, int]:
         """The recompile-freedom invariant the tests pin down: one decode
         step, one prefill program per power-of-two bucket, one chunk
-        program per power-of-two CHUNK bucket, and at most the two prefix
-        block-copy programs.  With chunking and the prefix pool off, the
-        chunk/block counts are 0 and the compiled set is exactly PR 7's."""
+        program per power-of-two CHUNK bucket, at most the two prefix
+        block-copy programs, and one speculative-verify program per block
+        width actually used.  With chunking, the prefix pool and
+        speculative decoding off, the chunk/block/verify counts are 0 and
+        the compiled set is exactly PR 7's."""
         return {"decode_steps": 1,
                 "prefill_buckets": len(self._prefills),
                 "prefill_chunk_buckets": len(self._chunks),
-                "prefix_block_ops": (0 if self._read_block is None else 2)}
+                "prefix_block_ops": (0 if self._read_block is None else 2),
+                "verify_widths": len(self._verifies)}

@@ -59,6 +59,21 @@ the compiled program set and the greedy tokens stay byte-identical:
   SIGTERM'd serve window stops admitting, finishes in-flight requests
   and flushes a consistent partial summary (``preempted`` names why).
 
+Round 14 attacks the decode step itself — **greedy-exact speculative
+decoding** (``draft_kv``/``draft_k``; Leviathan et al., arXiv:2211.17192):
+a draft model's own SlotKVCache runs in slot lockstep (admitted/evicted
+with the target), each iteration becomes draft-k → verify-1 (k draft
+steps propose, ONE batched target step scores all k+1 positions), and
+greedy acceptance — accept while draft token == target argmax, then take
+the target's token — makes the emitted stream **bitwise identical** to
+non-speculative decode; speculation changes iteration counts, never
+tokens.  Rollback of rejected positions is pure length bookkeeping on
+both tables (no KV rewrite).  ``serve_accept_rate`` + the
+proposed/accepted/rejected ledger (exact conservation) ride the summary;
+``serve_tokens_per_sec`` stays emitted-tokens-only, and ITL gaps are
+attributed per emitted token (a round's batch-mates land at gap 0), so
+the SLO math stays honest.
+
 Clocks are injectable: ``WallClock`` (real time; idle waits sleep until
 the next arrival — the open-loop bench) or ``VirtualClock`` (time = decode
 iterations; deterministic staggered-arrival tests).
@@ -276,6 +291,11 @@ class RequestResult:
     queue_wait_s: float = 0.0
     prefill_s: float = 0.0
     slo_met: bool | None = None   # None: no SLOMonitor attached
+    # speculative-decode accounting (zero when no draft is attached):
+    # draft tokens proposed for / accepted by this request's slot —
+    # conservation holds exactly: accepted + rejected == proposed
+    proposed_tokens: int = 0
+    accepted_tokens: int = 0
 
     @property
     def ttft_s(self) -> float:
@@ -319,7 +339,8 @@ class ContinuousBatcher:
     def __init__(self, kv: SlotKVCache, *, tracer=NULL_TRACER,
                  clock=None, mode: str = "continuous",
                  prefill_chunk: int = 0, metrics=None, slo=None,
-                 queue_cap: int = 0, should_stop=None):
+                 queue_cap: int = 0, should_stop=None,
+                 draft_kv: SlotKVCache | None = None, draft_k: int = 4):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be continuous|static, got {mode}")
         if prefill_chunk < 0:
@@ -330,6 +351,35 @@ class ContinuousBatcher:
             raise ValueError(
                 f"queue_cap must be >= 0 (0 = unbounded admission), got "
                 f"{queue_cap}")
+        if draft_kv is not None:
+            # speculative decoding (--serve-draft-config/--serve-draft-k):
+            # a small draft model proposes k tokens per live slot between
+            # target iterations, the target scores all k+1 positions in
+            # one batched verify step, and greedy acceptance keeps the
+            # emitted stream bitwise identical to non-speculative decode.
+            # The draft runs its own SlotKVCache in slot lockstep —
+            # admitted/evicted with the target, resynced by length
+            # bookkeeping after every round.
+            if draft_k < 1:
+                raise ValueError(
+                    f"draft_k must be >= 1 (draft tokens proposed per "
+                    f"verify round), got {draft_k}")
+            if not (kv.greedy and draft_kv.greedy):
+                raise ValueError(
+                    "speculative decoding requires greedy sampling on "
+                    "both the target and the draft: the exact acceptance "
+                    "rule only exists for greedy decode")
+            if draft_kv.slots != kv.slots:
+                raise ValueError(
+                    f"draft slot table ({draft_kv.slots}) must match the "
+                    f"target's ({kv.slots}): slots run in lockstep")
+            if draft_kv.max_len < kv.max_len:
+                raise ValueError(
+                    f"draft max_len ({draft_kv.max_len}) must cover the "
+                    f"target's ({kv.max_len}): the draft mirrors every "
+                    f"slot position")
+        self.draft_kv = draft_kv
+        self.draft_k = int(draft_k)
         self.kv = kv
         self.tracer = tracer
         self.clock = clock if clock is not None else WallClock()
@@ -388,6 +438,7 @@ class ContinuousBatcher:
         dec_span = tracer.span("decode", rid=req.rid, slot=slot)
         dec_span.__enter__()
         live[slot] = _Live(req, result, req_span, dec_span, now, req_attrs)
+        self._draft_admit(req.prompt, slot, first)
         if self._finished(live[slot]):
             # max_new_tokens == 1 (or instant EOS): the prefill's token was
             # the whole continuation — finish without a decode iteration
@@ -426,8 +477,23 @@ class ContinuousBatcher:
         dec_span.__enter__()
         live[slot] = _Live(req, result, pend["span"], dec_span, now,
                            pend["attrs"])
+        self._draft_admit(req.prompt, slot, first)
         if self._finished(live[slot]):
             self._finish(slot, live)
+
+    def _draft_admit(self, prompt, slot: int, first: int) -> None:
+        """Speculative decode: admit the same prompt into the draft
+        table's SAME slot (slot lockstep).  The draft's prefill samples
+        its own first token, which is DISCARDED — the committed pending
+        token is the target's, so the draft's first proposal next round
+        continues the real stream.  The draft prefill is monolithic and
+        unpooled by design: the chunked-prefill stall bound covers the
+        TARGET's programs, and this per-admission cost is draft-sized —
+        the reason production drafts are small (MIGRATING round 14)."""
+        if self.draft_kv is None:
+            return
+        self.draft_kv.insert(prompt, slot=slot)
+        self.draft_kv.tokens[slot] = int(first)
 
     def _finished(self, lv: _Live) -> bool:
         if len(lv.result.tokens) >= lv.req.max_new_tokens:
@@ -458,6 +524,8 @@ class ContinuousBatcher:
         lv.dec_span.__exit__(None, None, None)
         lv.req_span.__exit__(None, None, None)
         self.kv.evict(slot)
+        if self.draft_kv is not None and self.draft_kv.active[slot]:
+            self.draft_kv.evict(slot)
         self._results.append(lv.result)
 
     def _shed(self, req: Request, depth: int) -> None:
@@ -591,23 +659,119 @@ class ContinuousBatcher:
                 self._idle_wait(queue, nxt,  # bounded-slice sleep/jump
                                 decode_iterations)
                 continue
-            with tracer.span("decode_step", active=len(live)):
-                toks = kv.advance()
+            emitted = self._decode_round(live)
             decode_iterations += 1
-            self._decode_tokens += len(live)
             clock.on_decode_iteration()
             now = clock.now()
             for slot in sorted(live):
                 lv = live[slot]
-                tok = int(toks[slot])
-                lv.result.tokens.append(tok)
-                lv.result.itl_s.append(now - lv.last_t)
-                lv.last_t = now
-                if on_token is not None:
-                    on_token(lv.req.rid, tok)
-                if self._finished(lv):
-                    self._finish(slot, live)
+                for j, tok in enumerate(emitted[slot]):
+                    lv.result.tokens.append(tok)
+                    # ITL attribution per EMITTED token (the SLO math
+                    # stays honest): a verify round delivers its accepted
+                    # tokens at one host instant, so the first token of
+                    # the round carries the inter-round gap and its
+                    # batch-mates arrive at gap 0 — the gaps still sum to
+                    # the request's decode wall time
+                    lv.result.itl_s.append((now - lv.last_t) if j == 0
+                                           else 0.0)
+                    lv.last_t = now
+                    self._decode_tokens += 1
+                    if on_token is not None:
+                        on_token(lv.req.rid, tok)
+                    if self._finished(lv):
+                        self._finish(slot, live)
+                        break
         return decode_iterations, prefills, chunks
+
+    # ------------------------------------------------- speculative decode
+    def _decode_round(self, live: dict[int, _Live]) -> dict[int, list[int]]:
+        """One target decode iteration → per-slot emitted tokens.
+
+        Without a draft (or when speculation cannot help this round) this
+        is the single-token ``advance`` emitting exactly one token per
+        live slot — the compiled program and the tokens are byte-identical
+        to the draft-off batcher.  With a draft, the round becomes
+        draft-k → verify-1 (``_spec_round``): up to ``draft_k + 1``
+        tokens per slot from ONE target iteration."""
+        kv = self.kv
+        k_eff = self._spec_k(live) if self.draft_kv is not None else 0
+        if k_eff < 1:
+            with self.tracer.span("decode_step", active=len(live)):
+                toks = kv.advance()
+            return {slot: [int(toks[slot])] for slot in live}
+        return self._spec_round(live, k_eff)
+
+    def _spec_k(self, live: dict[int, _Live]) -> int:
+        """Per-round draft budget: ``draft_k`` capped by the table's
+        remaining write capacity (all k+1 verify positions must fit EVERY
+        live slot — SlotOverflow is a bookkeeping bug, never a tuning
+        knob) and by the longest remaining request budget (proposing past
+        every slot's finish line is pure draft waste; one round can emit
+        at most k+1 tokens, so k = longest-remaining − 1 suffices)."""
+        kv = self.kv
+        cap = min(kv.max_len - int(kv.lengths[s]) for s in live) - 1
+        needed = max(lv.req.max_new_tokens - len(lv.result.tokens)
+                     for lv in live.values()) - 1
+        return min(self.draft_k, cap, needed)
+
+    def _spec_round(self, live: dict[int, _Live],
+                    k_eff: int) -> dict[int, list[int]]:
+        """Draft-k → verify-1.  The draft autoregressively proposes
+        ``k_eff`` tokens for every live slot (k_eff single-token draft
+        iterations over the whole table), the target scores all k_eff+1
+        positions in ONE batched verify step, and each slot accepts the
+        longest draft prefix matching the target argmaxes plus the
+        target's own next token — exactly the tokens non-speculative
+        greedy decode would have emitted, bitwise.  Draft resync is pure
+        length bookkeeping (``rewind`` — rejected positions are never
+        rewritten); only a FULLY-accepted slot needs one masked catch-up
+        draft step, because its last proposal was never consumed by the
+        draft itself."""
+        kv, draft, tracer = self.kv, self.draft_kv, self.tracer
+        slots = sorted(live)
+        base = {s: int(kv.lengths[s]) for s in slots}
+        block = np.zeros((kv.slots, k_eff + 1), np.int32)
+        block[:, 0] = kv.tokens
+        with tracer.span("draft_propose", active=len(live), k=k_eff):
+            for j in range(k_eff):
+                block[:, j + 1] = draft.advance()
+                self._draft_iterations += 1
+        with tracer.span("decode_step", active=len(live),
+                         verify_width=k_eff + 1):
+            g = kv.verify_block(block)
+        emitted: dict[int, list[int]] = {}
+        full = np.zeros(kv.slots, np.bool_)
+        for s in slots:
+            a = 0
+            while a < k_eff and block[s, a + 1] == g[s, a]:
+                a += 1
+            emitted[s] = [int(t) for t in g[s, :a + 1]]
+            lv = live[s]
+            lv.result.proposed_tokens += k_eff
+            lv.result.accepted_tokens += a
+            self._proposed += k_eff
+            self._accepted += a
+            kv.commit_block(s, a + 1, int(g[s, a]))
+            if a < k_eff:
+                # rejected tail: rollback by length bookkeeping alone —
+                # draft positions base..base+a already hold the committed
+                # tokens' K/V (they were consumed during proposing)
+                draft.rewind(s, base[s] + a + 1, int(g[s, a]))
+            else:
+                full[s] = True
+        if full.any():
+            # fully-accepted slots: the draft emitted its k-th proposal
+            # without ever consuming it, so its cache is one committed
+            # token short — one masked draft step writes it (the draft's
+            # pending token IS that proposal), then the pending token is
+            # overridden with the target's bonus token
+            draft.advance(only=full)
+            self._draft_catchup += 1
+            for s in slots:
+                if full[s]:
+                    draft.tokens[s] = emitted[s][-1]
+        return emitted
 
     def run(self, requests: Iterable[Request] | RequestQueue,
             on_token: Callable[[int, int], None] | None = None,
@@ -628,6 +792,12 @@ class ContinuousBatcher:
         self._shed_count = 0
         self._shed_rids: list[int] = []
         self._preempted: str | None = None
+        # speculative-decode ledger (zeros when no draft is attached):
+        # conservation is exact — accepted + rejected == proposed
+        self._proposed = 0
+        self._accepted = 0
+        self._draft_iterations = 0
+        self._draft_catchup = 0
         if self.slo is not None:
             self.slo.reset()   # one monitor measures one window
         live: dict[int, _Live] = {}
@@ -654,6 +824,9 @@ class ContinuousBatcher:
                     lv.dec_span.__exit__(None, None, None)
                     lv.req_span.__exit__(None, None, None)
                     self.kv.evict(slot)
+                    if (self.draft_kv is not None
+                            and self.draft_kv.active[slot]):
+                        self.draft_kv.evict(slot)
                 for slot in sorted(pending):
                     pend = pending.pop(slot)
                     pend["span"].__exit__(None, None, None)
@@ -702,8 +875,29 @@ class ContinuousBatcher:
             "requests": len(results),
             "completed": len(results),
             # KV-table storage dtype (SlotKVCache kv_dtype — the --serve-
-            # kv-dtype memory knob); rides into the serve report section
+            # kv-dtype memory knob) + the stored bytes behind it, per
+            # slot (gated lower-is-better by `analyze diff`: the
+            # capacity-per-chip number int8/bf16 storage exists to
+            # shrink); both ride into the serve report section
             "serve_kv_dtype": getattr(self.kv, "kv_dtype", None),
+            "serve_kv_bytes_per_slot": self.kv.kv_bytes_per_slot(),
+            # speculative decoding (draft-k → verify-1): accept rate over
+            # THIS run's proposals (None: no draft attached — the key is
+            # always present so `analyze diff` gates it when both runs
+            # speculate) + the full ledger.  tokens_per_sec counts
+            # EMITTED tokens only (BASELINE.md accounting rule); accept
+            # rate is workload- and draft-dependent.
+            "serve_accept_rate": (self._accepted / self._proposed
+                                  if self._proposed else None),
+            "speculative": (None if self.draft_kv is None else {
+                "draft_k": self.draft_k,
+                "proposed_tokens": self._proposed,
+                "accepted_tokens": self._accepted,
+                "rejected_tokens": self._proposed - self._accepted,
+                "draft_iterations": self._draft_iterations,
+                "draft_catchup_steps": self._draft_catchup,
+                "draft_kv_dtype": self.draft_kv.kv_dtype,
+            }),
             "decode_iterations": decode_iterations,
             "prefills": prefills,
             "prefill_chunk": self.prefill_chunk,

@@ -230,7 +230,12 @@ class ExperimentConfig:
                                            # test split per request
     serve_kv_dtype: str | None = None      # --serve KV-table storage dtype
                                            # ('bfloat16' halves KV memory →
-                                           # double the slots per chip);
+                                           # double the slots per chip;
+                                           # 'int8' halves bf16's payload
+                                           # again — int8 K/V + one f32
+                                           # max-abs scale per written
+                                           # vector, tolerance-based token
+                                           # parity vs the bf16 oracle);
                                            # None: the model's dtype
     serve_prefill_chunk: int = 0           # >0: chunked prefill token
                                            # budget (Sarathi-Serve) — at
@@ -273,6 +278,22 @@ class ExperimentConfig:
                                            # to bounded queue wait, not
                                            # unbounded TTFT.  0 = admit
                                            # everything (PR 10 behavior)
+    serve_draft_config: str | None = None  # speculative decoding: 'self'
+                                           # (draft = the served model —
+                                           # accept rate 1, the mechanism
+                                           # check) or 'k=v,...' GPT size
+                                           # overrides (hidden/layers/
+                                           # heads/ffn; vocab + max_len
+                                           # inherited, fresh-initialized
+                                           # from --seed).  None = off:
+                                           # the pre-round-14 programs,
+                                           # byte-identical
+    serve_draft_k: int = 4                 # draft tokens proposed per
+                                           # verify round (k draft steps →
+                                           # one batched k+1-position
+                                           # target verify; greedy
+                                           # acceptance keeps the stream
+                                           # bitwise non-speculative)
 
 
 def enable_compile_cache(directory: str | os.PathLike) -> str:
@@ -1943,6 +1964,55 @@ def _sample_from_state(config: ExperimentConfig, ex: _Experiment, state,
     }
 
 
+def parse_draft_config(spec: str) -> dict[str, int] | None:
+    """``--serve-draft-config`` parser: the literal ``'self'`` → None
+    (the draft IS the served model and shares its params — accept rate 1,
+    the mechanism/parity configuration) or ``'key=int,...'`` GPT size
+    overrides (hidden/layers/heads/ffn/kv_heads; vocab and max_len always
+    inherit from the served model — draft proposals must be target
+    tokens, and the draft mirrors every slot position)."""
+    if spec == "self":
+        return None
+    allowed = ("ffn", "heads", "hidden", "kv_heads", "layers")
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, eq, val = part.partition("=")
+        key = key.strip()
+        if not eq or key not in allowed:
+            raise ValueError(
+                f"--serve-draft-config entries must be key=int with key "
+                f"in {allowed} (or the literal 'self'); got '{part}' — "
+                f"vocab/max_len inherit from the served model")
+        try:
+            out[key] = int(val)
+        except ValueError:
+            raise ValueError(
+                f"--serve-draft-config value for '{key}' must be an "
+                f"int, got '{val.strip()}'") from None
+    if not out:
+        raise ValueError(
+            "--serve-draft-config needs at least one key=int override "
+            "(or the literal 'self')")
+    return out
+
+
+def _resolve_serve_kv_dtype(name: str):
+    """``--serve-kv-dtype`` resolver: float dtype names via
+    models.resolve_dtype, plus ``'int8'`` — the quantized slot table
+    (int8 K/V + per-vector f32 scales, SlotKVCache kv_dtype)."""
+    if name == "int8":
+        return "int8"
+    try:
+        return modellib.resolve_dtype(name)
+    except KeyError:
+        raise ValueError(
+            f"--serve-kv-dtype '{name}' unknown: float32/bfloat16/"
+            f"float16 (and aliases) or int8") from None
+
+
 def _validate_serving(config: ExperimentConfig, ex: _Experiment,
                       test_ds) -> None:
     """Pre-train validation of the --serve window (same contract as
@@ -1994,6 +2064,16 @@ def _validate_serving(config: ExperimentConfig, ex: _Experiment,
         raise ValueError(
             f"--serve-queue-cap must be >= 0 (0 = unbounded admission), "
             f"got {config.serve_queue_cap}")
+    if config.serve_draft_k < 1:
+        raise ValueError(
+            f"--serve-draft-k must be positive, got "
+            f"{config.serve_draft_k}")
+    if config.serve_draft_config is not None:
+        # a malformed draft spec must fail BEFORE the training budget is
+        # spent, like every other deterministically-knowable serve flag
+        parse_draft_config(config.serve_draft_config)
+    if config.serve_kv_dtype:
+        _resolve_serve_kv_dtype(config.serve_kv_dtype)
     plen = config.serve_prompt_len
     if plen < 1 or plen > test_ds.x.shape[1]:
         raise ValueError(
@@ -2050,18 +2130,43 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
         mesh = ex.mesh
     kv_dtype = None
     if config.serve_kv_dtype:
-        from distributed_tensorflow_tpu import models as modellib
-
         # --serve-kv-dtype bfloat16: store the KV slot table in bf16 —
         # half the KV memory per slot (double the slots per chip at equal
         # HBM); greedy tokens stay oracle-exact on the shipped models
         # (tests/test_serving.py), the attention math still runs at the
-        # model's compute dtype via promotion
-        kv_dtype = modellib.resolve_dtype(config.serve_kv_dtype)
+        # model's compute dtype via promotion.  int8 halves bf16's
+        # payload again (int8 K/V + per-vector f32 scales); token parity
+        # vs the bf16 oracle is tolerance-based, not bitwise.
+        kv_dtype = _resolve_serve_kv_dtype(config.serve_kv_dtype)
     kv = SlotKVCache(ex.engine.model, params, config.serve_slots,
                      mesh=mesh, kv_dtype=kv_dtype,
                      prefix_cache_blocks=config.serve_prefix_cache,
                      prefix_block=config.serve_prefix_block)
+    draft_kv = None
+    if config.serve_draft_config:
+        # --serve-draft-config: speculative decoding — the draft runs its
+        # own full-precision SlotKVCache in slot lockstep with the target
+        # table.  'self' shares the served model AND params (zero extra
+        # param memory; the mechanism/parity configuration); a size spec
+        # builds a fresh GPT at those dims (vocab/max_len inherited) from
+        # the run seed — production use restores a trained draft here.
+        import jax.numpy as jnp
+
+        overrides = parse_draft_config(config.serve_draft_config)
+        model = ex.engine.model
+        if overrides is None:
+            draft_model, draft_params = model, params
+        else:
+            draft_model = modellib.create_model(
+                "gpt", num_classes=int(model.vocab_size),
+                max_len=int(model.max_len), dropout_rate=0.0,
+                dtype=model.dtype, **overrides)
+            dummy = jnp.zeros((1, min(8, int(model.max_len))), jnp.int32)
+            draft_params = jax.jit(
+                lambda k: draft_model.init(k, dummy, train=False)
+            )(jax.random.key(config.seed))["params"]
+        draft_kv = SlotKVCache(draft_model, draft_params,
+                               config.serve_slots, mesh=mesh)
     rows = np.asarray(test_ds.x, np.int32)
     plen = config.serve_prompt_len
     # --serve-shared-prefix: a fixed synthetic system prompt every request
@@ -2085,7 +2190,8 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
             prefill_chunk=config.serve_prefill_chunk,
             slo=SLOMonitor(config.serve_slo_ttft, config.serve_slo_itl),
             queue_cap=config.serve_queue_cap,
-            should_stop=should_stop).run(requests)
+            should_stop=should_stop,
+            draft_kv=draft_kv, draft_k=config.serve_draft_k).run(requests)
     return serve_section(summary, total_devices)
 
 

@@ -137,10 +137,14 @@ def test_insert_never_recompiles_decode(model_params):
     kv.insert(np.arange(4, dtype=np.int32) % 64)    # bucket 4 (cached)
     kv.insert(np.arange(7, dtype=np.int32) % 64)    # bucket 8
     kv.advance()
+    # round 14 adds the speculative-verify family to the pinned set:
+    # with spec decode (and chunking and the pool) off it is EMPTY — the
+    # compiled program set is exactly the PR 7 one
     assert kv.compiled_programs() == {"decode_steps": 1,
                                       "prefill_buckets": 2,
                                       "prefill_chunk_buckets": 0,
-                                      "prefix_block_ops": 0}
+                                      "prefix_block_ops": 0,
+                                      "verify_widths": 0}
 
 
 def test_chunked_prefill_programs_bucketed(model_params):
