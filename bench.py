@@ -1220,7 +1220,7 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                 sweep: bool = False, slo_ttft: float | None = None,
                 slo_itl: float | None = None, queue_cap: int = 0,
                 kv_dtype: str | None = None, draft: str | None = None,
-                draft_k: int | None = None) -> None:
+                draft_k: int | None = None, replicas: int = 0) -> None:
     """Serving throughput + latency percentiles of the continuous-batching
     engine (distributed_tensorflow_tpu/serving/) against the static-batch
     restart-per-``generate`` baseline, on the SAME synthetic open-loop
@@ -1315,6 +1315,11 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
     draft = draft or env("BENCH_SERVE_DRAFT", "") or None
     if draft_k is None:
         draft_k = int(env("BENCH_SERVE_DRAFT_K", "4"))
+    # round 15: --replicas N — fleet mode (serving/fleet.py ReplicaSet):
+    # a clean N-replica window plus a kill-one-replica chaos window at a
+    # seeded decode iteration, emitted as its own line
+    replicas = replicas or int(env("BENCH_SERVE_REPLICAS", "0"))
+    kill_iter = int(env("BENCH_SERVE_KILL_ITER", "8"))
 
     mesh = with_backend_retry(meshlib.create_mesh)
     n = mesh.shape[meshlib.DATA_AXIS]
@@ -1377,16 +1382,21 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
     if kv_dtype:
         resolved_kv_dtype = ("int8" if kv_dtype == "int8"
                              else jnp.dtype(jnp.bfloat16))
-    kv = SlotKVCache(model, params, slots, mesh=mesh,
-                     kv_dtype=resolved_kv_dtype,
-                     prefix_cache_blocks=cache_blocks,
-                     prefix_block=prefix_block)
-    kv_base = SlotKVCache(model, params, slots, mesh=mesh)
-    kv_cmp = None
-    if resolved_kv_dtype is not None:
-        kv_cmp = SlotKVCache(model, params, slots, mesh=mesh,
-                             prefix_cache_blocks=cache_blocks,
-                             prefix_block=prefix_block)
+    fleet_mode = bool(replicas and replicas > 1)
+    # fleet mode builds its own 2×N per-replica tables below and never
+    # dispatches these — skip the construction too (each table allocates
+    # the full slots×max_len KV buffers on device)
+    kv = kv_base = kv_cmp = None
+    if not fleet_mode:
+        kv = SlotKVCache(model, params, slots, mesh=mesh,
+                         kv_dtype=resolved_kv_dtype,
+                         prefix_cache_blocks=cache_blocks,
+                         prefix_block=prefix_block)
+        kv_base = SlotKVCache(model, params, slots, mesh=mesh)
+        if resolved_kv_dtype is not None:
+            kv_cmp = SlotKVCache(model, params, slots, mesh=mesh,
+                                 prefix_cache_blocks=cache_blocks,
+                                 prefix_block=prefix_block)
     # speculative decoding: the draft's own full-precision table, in slot
     # lockstep with `kv` (windows evict everything on exit, so sharing
     # one draft table across windows is safe like sharing `kv`)
@@ -1407,8 +1417,9 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                 lambda: jax.jit(lambda k: draft_model.init(
                     k, dummy, train=False))(
                         jax.random.key(1))["params"], "draft init")
-        draft_kv = SlotKVCache(draft_model, draft_params, slots,
-                               mesh=mesh)
+        if not fleet_mode:
+            draft_kv = SlotKVCache(draft_model, draft_params, slots,
+                                   mesh=mesh)
 
     def _warm():
         # compile the decode step + every prefill bucket AND chunk bucket
@@ -1474,7 +1485,10 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
         note(f"warm: production {kv.compiled_programs()}, "
              f"baseline {kv_base.compiled_programs()}")
 
-    with_backend_retry(_warm, "first compile/warmup")
+    if not fleet_mode:
+        # fleet mode warms its own per-replica tables below — the
+        # single-replica kv/kv_base/kv_cmp tables are not even built
+        with_backend_retry(_warm, "first compile/warmup")
 
     tracer = Tracer(path=trace_path) if trace_path else NULL_TRACER
     partial_errors: list[str] = []
@@ -1521,6 +1535,224 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                  f"{summary['shed_requests']} shed")
             return summary
         return _one
+
+    if fleet_mode:
+        # ------------------------------------------- fleet mode (round 15)
+        # A clean N-replica ReplicaSet window (least-loaded router, every
+        # replica its own production-config table) and a CHAOS window on
+        # the SAME seeded trace with one replica crash-injected at a
+        # seeded decode iteration — the failover keys
+        # (serve_failover_recovery_p95_s, serve_duplicate_emissions) and
+        # the exactly-once conservation check come from the chaos window;
+        # throughput is the clean window's.
+        from distributed_tensorflow_tpu.serving import (
+            FaultInjector, ReplicaSet)
+
+        def fleet_tables(count):
+            tables = []
+            for _ in range(count):
+                t = SlotKVCache(model, params, slots, mesh=mesh,
+                                kv_dtype=resolved_kv_dtype,
+                                prefix_cache_blocks=cache_blocks,
+                                prefix_block=prefix_block)
+                # warm THIS table's programs outside the timed windows
+                # (same discipline as _warm: chunk buckets + monolithic
+                # buckets + one pool hit)
+                lens = sorted({len(p) for p in prompts})
+                if chunk:
+                    # same doubling enumeration as _warm: every
+                    # power-of-two chunk-tail bucket below the budget,
+                    # plus the budget itself
+                    buckets, b = [chunk], 1
+                    while b < chunk:
+                        buckets.append(b)
+                        b *= 2
+                    for blen in sorted(set(buckets)):
+                        slot, _ = t.begin_insert(
+                            rng.integers(0, vocab, blen).astype(np.int32))
+                        while t.prefill_chunk(slot, chunk) is None:
+                            pass
+                        t.advance()
+                        t.evict(slot)
+                for plen in lens:
+                    slot, _ = t.insert(prompts[
+                        [len(p) for p in prompts].index(plen)])
+                    t.advance()
+                    t.evict(slot)
+                if cache_blocks:
+                    longest = max(prompts, key=len)
+                    for _ in range(2):
+                        slot, _ = t.insert(longest)
+                        t.advance()
+                        t.evict(slot)
+                t.reset_prefix_cache()
+                tables.append(t)
+            return tables
+
+        def fleet_drafts(count):
+            if not draft:
+                return None
+            return [SlotKVCache(draft_model, draft_params, slots,
+                                mesh=mesh) for _ in range(count)]
+
+        # one table set for the clean windows, a FRESH set for the chaos
+        # window (arming a FaultInjector monkeypatches table methods —
+        # the clean tables must stay pristine); compiles happen here,
+        # outside every timed window — incl. the drafts' programs and
+        # every verify width a speculative round can hit (throwaway spec
+        # windows, the same first-compile guard _warm's spec-warm gives
+        # the single-replica path)
+        clean_tables = with_backend_retry(
+            lambda: fleet_tables(replicas), "fleet tables")
+        chaos_tables = with_backend_retry(
+            lambda: fleet_tables(replicas), "fleet chaos tables")
+        clean_drafts = fleet_drafts(replicas)
+        chaos_drafts = fleet_drafts(replicas)
+
+        def warm_spec(tables, drafts):
+            if drafts is None:
+                return
+            for t, d in zip(tables, drafts):
+                spec_warm = ContinuousBatcher(
+                    t, mode="continuous", prefill_chunk=chunk,
+                    draft_kv=d, draft_k=draft_k)
+                for m in range(2, draft_k + 3):
+                    spec_warm.run([Request(rid=-m, prompt=prompts[m % 2],
+                                           max_new_tokens=m,
+                                           arrival_s=0.0)])
+                if t.prefix_cache_blocks:
+                    t.reset_prefix_cache()
+
+        with_backend_retry(lambda: warm_spec(clean_tables, clean_drafts),
+                           "fleet draft warm")
+        with_backend_retry(lambda: warm_spec(chaos_tables, chaos_drafts),
+                           "fleet chaos draft warm")
+
+        def fleet_window(label, tables, drafts, fault_spec=None):
+            def _one(rep):
+                for t in tables:
+                    if t.prefix_cache_blocks:
+                        # cold pool per window (the BASELINE pool-warmth
+                        # rule): the hit rate is a property of the
+                        # workload, not the window ordinal
+                        t.reset_prefix_cache()
+                injector = (FaultInjector(fault_spec, seed=rep)
+                            if fault_spec else None)
+                rs = ReplicaSet(
+                    tables, tracer=tracer, prefill_chunk=chunk,
+                    queue_cap=queue_cap,
+                    slo=SLOMonitor(slo_ttft, slo_itl),
+                    draft_kvs=drafts, draft_k=draft_k,
+                    watchdog_timeout_s=float(
+                        env("BENCH_SERVE_WATCHDOG_S", "0")),
+                    fault_injector=injector)
+                try:
+                    summary = serve_section(rs.run(workload(),
+                                                   on_token=on_token), n)
+                finally:
+                    rs.close()
+                fl = summary["serve_fleet"]
+                note(f"{label} window {rep}: "
+                     f"{summary['completed']}/{summary['offered']} done, "
+                     f"{fl['failovers']} failovers, "
+                     f"{fl['duplicate_emissions']} dups, "
+                     f"{summary['serve_requests_per_sec_per_chip']:.3f} "
+                     f"req/s/chip")
+                return summary
+            return _one
+
+        try:
+            clean = measure_windows(
+                fleet_window("fleet", clean_tables, clean_drafts),
+                repeats, "fleet", partial_errors)
+            if not clean:
+                raise RuntimeError(f"no fleet window completed: "
+                                   f"{partial_errors[-1]}")
+            chaos_spec = f"crash:replica=0,iter={kill_iter}"
+            chaos_wins = measure_windows(
+                fleet_window("fleet_chaos", chaos_tables, chaos_drafts,
+                             fault_spec=chaos_spec),
+                1, "fleet_chaos", partial_errors)
+            chaos = chaos_wins[0] if chaos_wins else None
+        finally:
+            tracer.close()
+        line = {k: med(clean, k) for k in (
+            "serve_requests_per_sec_per_chip", "serve_requests_per_sec",
+            "serve_tokens_per_sec", "serve_ttft_p50_s",
+            "serve_ttft_p95_s", "serve_ttft_p99_s", "serve_itl_p50_s",
+            "serve_itl_p95_s", "serve_itl_p99_s",
+            "serve_goodput_under_slo", "serve_shed_rate")}
+        rps = line["serve_requests_per_sec_per_chip"]
+        chaos_fl = (chaos or {}).get("serve_fleet") or {}
+        print(json.dumps({
+            "metric": "gpt_serve_fleet_requests_per_sec_per_chip",
+            "value": round(rps, 4) if rps else None,
+            "unit": "requests/sec/chip",
+            "vs_baseline": None,
+            "method": (f"{replicas}-replica ReplicaSet, least-loaded "
+                       f"router, open-loop Poisson {rate}/s × "
+                       f"{n_requests} requests, median of {len(clean)}; "
+                       f"chaos window: seeded crash of replica 0 at "
+                       f"decode iteration {kill_iter}"),
+            **{k: (round(v, 6) if isinstance(v, float) else v)
+               for k, v in line.items()},
+            "replicas": replicas,
+            "serve_fleet": clean[0].get("serve_fleet"),
+            # the failover gate keys come from the CHAOS window (the
+            # clean window has no failovers to measure)
+            "serve_failover_recovery_p95_s": (
+                (chaos or {}).get("serve_failover_recovery_p95_s")),
+            "serve_duplicate_emissions": (
+                (chaos or {}).get("serve_duplicate_emissions")),
+            "chaos": None if chaos is None else {
+                "kill_iteration": kill_iter,
+                "offered": chaos["offered"],
+                "completed": chaos["completed"],
+                "unserved_requests": chaos["unserved_requests"],
+                "shed_requests": chaos["shed_requests"],
+                "conservation_exact": (
+                    chaos["completed"] + chaos["shed_requests"]
+                    + chaos["unserved_requests"] == chaos["offered"]),
+                "completed_exactly_once": (
+                    chaos["completed"] == chaos["offered"]
+                    and chaos["serve_duplicate_emissions"] == 0),
+                "failovers": chaos_fl.get("failovers"),
+                "retries": chaos_fl.get("retries"),
+                "requeued_requests": chaos_fl.get("requeued_requests"),
+                "fenced_emissions": chaos_fl.get("fenced_emissions"),
+                "recovery_p95_s": chaos_fl.get(
+                    "failover_recovery_p95_s"),
+            },
+            "slo": {"ttft_s": slo_ttft, "itl_s": slo_itl,
+                    "quantile": 0.99},
+            "config": {"slots_per_replica": slots, "replicas": replicas,
+                       "requests": n_requests,
+                       "arrival_rate_per_s": rate,
+                       "prompt_len": prompt_len,
+                       "max_new_tokens": max_new, "vocab": vocab,
+                       "hidden": hidden, "layers": layers,
+                       "heads": heads, "ffn": ffn, "max_len": max_len,
+                       "dtype": "bfloat16", "greedy": True,
+                       "prefill_chunk": chunk,
+                       "prefix_cache_blocks": cache_blocks,
+                       "prefix_block": prefix_block,
+                       "shared_prefix": shared_len,
+                       "long_every": long_every,
+                       "kv_dtype": clean_tables[0].kv_dtype,
+                       "draft": draft,
+                       "draft_k": draft_k if draft else None,
+                       "kill_iter": kill_iter},
+            "device": device_kind,
+            "n_devices": n,
+            "synthetic": True,
+            "jax_version": jax.__version__,
+            "xla_flags": os.environ.get("XLA_FLAGS"),
+            "libtpu_init_args": os.environ.get("LIBTPU_INIT_ARGS"),
+            **({"partial": {"errors": partial_errors,
+                            "fleet_windows": len(clean)}}
+               if partial_errors else {}),
+        }))
+        return
 
     if sweep:
         # ------------------------------------------------ SLO load harness
@@ -1823,6 +2055,7 @@ _MODE_METRICS = {
     "decode": "gpt_lm_decode_tokens_per_sec_per_chip",
     "serve": "gpt_serve_requests_per_sec_per_chip",
     "serve_sweep": "gpt_serve_max_goodput_under_slo",
+    "serve_fleet": "gpt_serve_fleet_requests_per_sec_per_chip",
     "default": "mnist_cnn_sync_examples_per_sec_per_chip",
 }
 
@@ -1900,6 +2133,16 @@ def main() -> None:
     p.add_argument("--serve-draft-k", type=int, default=None, metavar="K",
                    help="--serve-draft: draft tokens proposed per verify "
                         "round (default BENCH_SERVE_DRAFT_K or 4)")
+    p.add_argument("--replicas", type=int, default=0, metavar="N",
+                   help="--serve: fleet mode (serving/fleet.py) — a "
+                        "clean N-replica ReplicaSet window plus a "
+                        "kill-one-replica chaos window (seeded crash at "
+                        "decode iteration BENCH_SERVE_KILL_ITER, default "
+                        "8) on the same trace; the line reports fleet "
+                        "requests/sec/chip, serve_failover_recovery_"
+                        "p95_s, serve_duplicate_emissions and the "
+                        "exactly-once conservation check (default "
+                        "BENCH_SERVE_REPLICAS or off)")
     p.add_argument("--steps", type=int, default=100,
                    help="--stream: measured steps per repetition (the test "
                         "suite's smoke invocation shrinks this, plus "
@@ -1968,8 +2211,12 @@ def main() -> None:
             else "attention" if args.attention
             else "lm" if args.lm else "moe" if args.moe
             else "decode" if args.decode else "default")
+    fleet_n = args.replicas or int(os.environ.get("BENCH_SERVE_REPLICAS",
+                                                  "0"))
     metric = (_MODE_METRICS["serve_sweep"]
-              if mode == "serve" and args.sweep else _MODE_METRICS[mode])
+              if mode == "serve" and args.sweep
+              else _MODE_METRICS["serve_fleet"]
+              if mode == "serve" and fleet_n > 1 else _MODE_METRICS[mode])
     if not args.no_probe:
         ensure_backend(metric)
     try:
@@ -1980,7 +2227,8 @@ def main() -> None:
                         queue_cap=args.serve_queue_cap,
                         kv_dtype=args.serve_kv_dtype,
                         draft=args.serve_draft,
-                        draft_k=args.serve_draft_k)
+                        draft_k=args.serve_draft_k,
+                        replicas=args.replicas)
         elif mode == "stream":
             bench_stream(steps=max(args.steps, 1),
                          grad_compression=args.grad_compression,

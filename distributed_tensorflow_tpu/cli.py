@@ -271,6 +271,58 @@ def build_parser() -> argparse.ArgumentParser:
                         "event) so overload degrades to bounded queue "
                         "wait instead of unbounded TTFT (0 = admit "
                         "everything)")
+    p.add_argument("--serve-replicas", type=int, default=1, metavar="N",
+                   help="--serve: run the window through a ReplicaSet "
+                        "fleet of N continuous-batching replicas "
+                        "(serving/fleet.py), each with its own "
+                        "--serve-slots KV table, behind a least-loaded "
+                        "router.  A replica failure (crash, watchdog "
+                        "stall, detected corruption) requeues its queued "
+                        "AND in-flight requests to survivors with "
+                        "bounded retry — already-streamed tokens are "
+                        "never re-emitted (journal fence; resume "
+                        "re-prefills prompt+emitted prefix, greedy-"
+                        "exact) and retry TTFT stays charged from the "
+                        "original arrival.  The serve section gains "
+                        "serve_fleet + serve_failover_recovery_p95_s / "
+                        "serve_duplicate_emissions (gated by `analyze "
+                        "diff`).  1 (default) = the single-replica "
+                        "batcher, byte-identical behavior")
+    p.add_argument("--serve-fault-spec", default=None, metavar="SPEC",
+                   help="--serve: seeded fault injection into the fleet "
+                        "(forces fleet supervision even at 1 replica). "
+                        "SPEC is 'kind:key=val,...[;kind:...]' with kind "
+                        "crash|stall|nanlogits and keys replica=N plus "
+                        "iter=K (K-th decode iteration) / prefill=K / "
+                        "verify=K (crash between verify and commit) / "
+                        "prob=P (seeded Bernoulli) / stall_s=S.  E.g. "
+                        "'crash:replica=0,iter=3'.  The chaos-test "
+                        "substrate: every offered request must still "
+                        "complete exactly once on the survivors.  NB "
+                        "stall faults are only DETECTED (fenced + failed "
+                        "over) when --serve-watchdog is set; without it "
+                        "the stall just runs its course")
+    p.add_argument("--serve-watchdog", type=float, default=0.0,
+                   metavar="S",
+                   help="--serve-replicas: supervisor watchdog — fail "
+                        "over a replica that made no token progress for "
+                        "S seconds while busy (the zombie is FENCED, "
+                        "not killed: its late emissions are rejected by "
+                        "the journal).  Set S above worst-case first-"
+                        "program compile time — the watchdog cannot "
+                        "tell a stall from an XLA compile.  0 (default) "
+                        "= off")
+    p.add_argument("--serve-hot-swap", action="store_true",
+                   help="--serve: zero-downtime weight hot-swap drill — "
+                        "after half the window completes, each replica "
+                        "in turn stops admitting, finishes in-flight, "
+                        "swaps the served params between compiled-"
+                        "program dispatches (never recompiles, fleet "
+                        "never below N-1 admitting replicas) and "
+                        "resumes; swap_generations >= 1 in serve_fleet "
+                        "proves it.  The drill re-installs the same "
+                        "trained params so greedy tokens are unchanged; "
+                        "a real rollout passes a new checkpoint")
     p.add_argument("--model-arg", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="extra model constructor field (repeatable), e.g. "
@@ -613,6 +665,10 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         serve_queue_cap=args.serve_queue_cap,
         serve_draft_config=args.serve_draft_config,
         serve_draft_k=args.serve_draft_k,
+        serve_replicas=args.serve_replicas,
+        serve_fault_spec=args.serve_fault_spec,
+        serve_hot_swap=args.serve_hot_swap,
+        serve_watchdog_s=args.serve_watchdog,
     )
     summary = run(config)  # run() itself wraps recovery when max_restarts>0
     print(json.dumps(summary))

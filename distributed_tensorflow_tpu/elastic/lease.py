@@ -64,6 +64,14 @@ class LeaseManager:
         # report() taken after the run's teardown still records that the
         # handler WAS armed while training ran
         self.preempt_signal: int | None = None
+        # programmatic trigger (serving/fleet.py replica drain, tests):
+        # same contract as the signal flag — one assignment under a lock,
+        # read at the consumer's next boundary.  The lock matters for
+        # trigger/reset pairs racing across threads (a fleet coordinator
+        # triggering while a replica worker resets after its drain), not
+        # for the flag read itself.
+        self._trigger_lock = threading.Lock()
+        self.trigger_reason: str | None = None
 
     # ----------------------------------------------------------- signals
     def _on_signal(self, signum, frame) -> None:  # noqa: ARG002
@@ -99,12 +107,36 @@ class LeaseManager:
         self.uninstall()
 
     # -------------------------------------------------------------- hook
+    def trigger(self, reason: str) -> None:
+        """Programmatic preemption: flip the drain flag as if a notice
+        arrived, without a real signal — the fleet supervisor's
+        replica-drain path (serving/fleet.py weight hot-swap) and tests
+        use this instead of delivering SIGTERM to the whole process.
+        Thread-safe; the first reason wins until ``reset_trigger``."""
+        if not reason:
+            raise ValueError("trigger needs a non-empty reason string")
+        with self._trigger_lock:
+            if self.trigger_reason is None:
+                self.trigger_reason = str(reason)
+
+    def reset_trigger(self) -> None:
+        """Re-arm after a programmatic drain completed (a swapped replica
+        resumes serving on the same lease).  Only clears the programmatic
+        flag — a real preemption signal stays sticky: the process is
+        still going away, and un-noticing it would serve requests into
+        the kill."""
+        with self._trigger_lock:
+            self.trigger_reason = None
+
     def should_stop(self, steps_done: int) -> str | None:
         """The ``Trainer.fit(should_stop=)`` hook: a reason string when
-        the lease is over (preemption notice received, or ``steps_done``
-        this fit reached the per-lease budget), else None."""
+        the lease is over (preemption notice received, programmatic
+        ``trigger``, or ``steps_done`` this fit reached the per-lease
+        budget), else None."""
         if self.preempt_signal is not None:
             return f"signal:{_signal_name(self.preempt_signal)}"
+        if self.trigger_reason is not None:
+            return self.trigger_reason
         if (self.max_steps_per_lease
                 and steps_done >= self.max_steps_per_lease):
             return f"max_steps_per_lease:{self.max_steps_per_lease}"
@@ -117,4 +149,5 @@ class LeaseManager:
             "max_steps_per_lease": self.max_steps_per_lease or None,
             "signal_handler_installed": self.was_installed,
             "preempt_signal": _signal_name(self.preempt_signal),
+            "triggered": self.trigger_reason,
         }

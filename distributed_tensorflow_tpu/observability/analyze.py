@@ -205,14 +205,32 @@ def serve_waterfall(records: list[dict]) -> dict[str, Any]:
     several windows that all reuse rids 0..n−1 — every window's spans
     get their own rows, and each chunk attaches to the request span
     whose [start, end] interval contains it), arrival-ordered — the
-    queue→prefill-chunks→decode story of every request served."""
+    queue→prefill-chunks→decode story of every request served.
+
+    Fleet traces (serving/fleet.py) add failover: each ``requeue`` event
+    is a retry hop — the retried request's NEXT request span is its new
+    segment on the surviving replica.  Same-rid rows get an ``attempt``
+    number in time order, retried rows carry the hop's
+    ``original_arrival_s`` (retry TTFT is charged from the ORIGINAL
+    arrival — the row is keyed to it, not to the requeue time), and the
+    hops ride the output as ``requeues``."""
     rows: list[dict[str, Any]] = []
     chunk_recs: list[dict[str, Any]] = []
     shed: list[dict[str, Any]] = []
+    requeues: list[dict[str, Any]] = []
     for rec in records:
         kind = rec.get("event")
         rid = rec.get("rid")
         if rid is None:
+            continue
+        if kind == "event" and rec.get("name") == "requeue":
+            requeues.append({"rid": rid, "t": rec.get("t"),
+                             "from_replica": rec.get("from_replica"),
+                             "to_replica": rec.get("to_replica"),
+                             "attempt": rec.get("attempt"),
+                             "arrival_s": rec.get("arrival_s"),
+                             "emitted": rec.get("emitted"),
+                             "reason": rec.get("reason")})
             continue
         if kind == "span" and rec.get("name") == "request":
             rows.append({
@@ -253,12 +271,43 @@ def serve_waterfall(records: list[dict]) -> dict[str, Any]:
                 row["prefill_chunks"].append(
                     {k: v for k, v in c.items() if k != "rid"})
                 break
+    # failover attribution: a row is a RETRY segment (attempt 2, 3, ...)
+    # only when a requeue hop for its rid landed between the previous
+    # same-rid row's start and this row's start — bench traces reuse
+    # rids 0..n−1 across windows, so bare same-rid counting would tag
+    # every later window's rows as phantom retries.  The hop's original
+    # arrival keys the retried row (the retry-TTFT accounting rule).
+    hops_by_rid: dict[Any, list] = {}
+    for q in requeues:
+        if q.get("t") is not None:
+            hops_by_rid.setdefault(q["rid"], []).append(q)
+    last_row: dict[Any, dict[str, Any]] = {}
+    for row in rows:   # rows are already time-sorted
+        prev = last_row.get(row["rid"])
+        attempt, hop = 1, None
+        if prev is not None and row["t"] is not None \
+                and prev["t"] is not None:
+            for q in hops_by_rid.get(row["rid"], ()):
+                if prev["t"] <= q["t"] <= row["t"]:
+                    hop = q
+            if hop is not None:
+                # the journal's own attempt number when the hop carries
+                # it (a request can hop twice while QUEUED, leaving no
+                # span between — prev+1 would undercount against the
+                # requeue rows rendered alongside)
+                attempt = hop.get("attempt") or (prev["attempt"] + 1)
+        row["attempt"] = attempt
+        if hop is not None and hop.get("arrival_s") is not None:
+            row["original_arrival_s"] = hop["arrival_s"]
+        last_row[row["rid"]] = row
     met = [r["slo_met"] for r in rows if r.get("slo_met") is not None]
     return {
         "requests": rows,
         "shed": shed,
+        "requeues": requeues,
         "requests_n": len(rows),
         "shed_n": len(shed),
+        "requeue_n": len(requeues),
         "slo_met_n": sum(bool(m) for m in met) if met else None,
     }
 
@@ -292,9 +341,29 @@ def render_waterfall_text(wf: dict[str, Any], width: int = 60) -> str:
                + "#" * max(int(max(d, 0.0) * scale), 1))
         slo = ("" if r.get("slo_met") is None
                else (" SLO+" if r["slo_met"] else " SLO-"))
+        # a retry hop's new span segment: tagged with its attempt number
+        # and (when the requeue event carried it) the ORIGINAL arrival
+        # the retried request's TTFT is charged from
+        retry = ""
+        if (r.get("attempt") or 1) > 1:
+            orig = r.get("original_arrival_s")
+            retry = (f" retry#{r['attempt']}"
+                     + (f" (orig arrival {orig:.4f}s)"
+                        if orig is not None else ""))
         out.append(f"{str(r['rid']):>6} |{bar:<{width + 4}}| "
                    f"q={q:.4f}s p={p:.4f}s d={max(d, 0.0):.4f}s"
-                   f"{slo}")
+                   f"{slo}{retry}")
+    for rq in wf.get("requeues", ()):
+        # the hop itself: where on the shared axis the request left its
+        # dead replica for a survivor (same clamping as shed marks —
+        # requeue events are emitted immediately, spans only at exit)
+        off = int(max((rq["t"] or 0) - t0, 0.0) * scale)
+        off = min(max(off, 0), width + 3)
+        out.append(f"{str(rq['rid']):>6} |{' ' * off}>"
+                   f"{'':<{max(width + 3 - off, 0)}}"
+                   f"| requeue r{rq.get('from_replica')}→"
+                   f"r{rq.get('to_replica')} after "
+                   f"{rq.get('emitted')} tokens ({rq.get('reason')})")
     for s in wf["shed"]:
         # clamp into the axis: overload events are emitted immediately
         # while request spans only land at exit, so a partial trace can
@@ -305,8 +374,9 @@ def render_waterfall_text(wf: dict[str, Any], width: int = 60) -> str:
         out.append(f"{str(s['rid']):>6} |{' ' * off}x"
                    f"{'':<{max(width + 3 - off, 0)}}"
                    f"| shed (429) at depth {s.get('queue_depth')}")
-    out.append(f"legend: .=queue =prefill #=decode x=shed; "
-               f"{wf['requests_n']} served, {wf['shed_n']} shed")
+    out.append(f"legend: .=queue =prefill #=decode x=shed >=requeue; "
+               f"{wf['requests_n']} served, {wf['shed_n']} shed, "
+               f"{wf.get('requeue_n', 0)} requeued")
     return "\n".join(out)
 
 
@@ -468,6 +538,14 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     # tokens only) is already listed above.
     ("serve_accept_rate", "higher"),
     ("serve_kv_bytes_per_slot", "lower"),
+    # fleet robustness (round 15; BASELINE.md "Failover accounting"):
+    # failover recovery — replica-failure detection to the failed-over
+    # request's first post-requeue delivery — is the seconds a reader's
+    # stream stood still, and duplicate emissions are the exactly-once
+    # claim measured (0 by construction; any growth is a journal-fence
+    # regression).  Both lower-is-better.
+    ("serve_failover_recovery_p95_s", "lower"),
+    ("serve_duplicate_emissions", "lower"),
 )
 
 

@@ -39,6 +39,7 @@ from __future__ import annotations
 import contextlib
 import os
 import socket
+import threading
 import time
 import uuid
 from pathlib import Path
@@ -116,7 +117,12 @@ class Tracer:
         self._annotate = annotate
         self._sink = sink if sink is not None else (
             AsyncJsonlSink(path) if path else None)
-        # per-name aggregates: name -> [count, total_s, max_s]
+        # per-name aggregates: name -> [count, total_s, max_s].  The
+        # read-modify-write updates are lock-guarded: the serving fleet
+        # (serving/fleet.py) shares ONE tracer across N replica worker
+        # threads, and concurrent span exits would otherwise lose counts
+        # (the JSONL sink is queue-based and was already thread-safe)
+        self._agg_lock = threading.Lock()
         self._spans: dict[str, list] = {}
         self._counters: dict[str, int] = {}
         if self._sink is not None:
@@ -148,10 +154,11 @@ class Tracer:
             finally:
                 dur = time.perf_counter() - t0
                 t_book = time.perf_counter()
-                agg = self._spans.setdefault(name, [0, 0.0, 0.0])
-                agg[0] += 1
-                agg[1] += dur
-                agg[2] = max(agg[2], dur)
+                with self._agg_lock:
+                    agg = self._spans.setdefault(name, [0, 0.0, 0.0])
+                    agg[0] += 1
+                    agg[1] += dur
+                    agg[2] = max(agg[2], dur)
                 self._emit({"event": "span", "name": name, "t": t_mono,
                             "dur_s": dur, **attrs})
                 self.overhead_s += time.perf_counter() - t_book
@@ -170,9 +177,11 @@ class Tracer:
 
     def counter(self, name: str, inc: int = 1, **fields: Any) -> None:
         t0 = time.perf_counter()
-        self._counters[name] = self._counters.get(name, 0) + inc
+        with self._agg_lock:
+            self._counters[name] = total = \
+                self._counters.get(name, 0) + inc
         self._emit({"event": "counter", "name": name, "t": time.monotonic(),
-                    "inc": inc, "total": self._counters[name], **fields})
+                    "inc": inc, "total": total, **fields})
         self.overhead_s += time.perf_counter() - t0
 
     # ------------------------------------------------------------- summary

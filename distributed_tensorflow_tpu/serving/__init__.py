@@ -1,6 +1,6 @@
 """Continuous-batching inference serving (ROADMAP item 1).
 
-Two halves:
+Three layers:
 
 * ``kv_cache.SlotKVCache`` — the device half: a fixed slot table of KV
   buffers sharded over the training mesh, one compiled single-token decode
@@ -16,6 +16,13 @@ Two halves:
   iteration — Sarathi-Serve stall bounding) with MLPerf-style TTFT/ITL
   percentile accounting, a prefill/decode token split, and per-request
   trace spans through the existing observability stack.
+* ``fleet.ReplicaSet`` — the fault-tolerance layer: N batcher replicas
+  behind a least-loaded router, a request journal with an exactly-once
+  emission fence, no-loss failover with bounded retry (resume
+  re-prefills prompt + emitted prefix, greedy-exact), seeded fault
+  injection (``FaultInjector``), and graceful drain + zero-downtime
+  weight hot-swap (``SlotKVCache.swap_params``) that never drops the
+  fleet below N−1 admitting replicas.
 
 ``bench.py --serve`` drives an open-loop arrival process through both and
 reports requests/sec/chip + latency percentiles; the harness's ``--serve``
@@ -23,6 +30,9 @@ flag runs a post-training serving window whose summary lands in the run
 report, gated by ``analyze diff`` exactly like the training metrics.
 """
 
+from distributed_tensorflow_tpu.serving.fleet import (  # noqa: F401
+    CorruptionDetected, FaultInjector, FaultSpec, InjectedFault,
+    ReplicaSet, RequestJournal, build_replica_kvs)
 from distributed_tensorflow_tpu.serving.kv_cache import (  # noqa: F401
     SlotKVCache, SlotOverflow)
 from distributed_tensorflow_tpu.serving.scheduler import (  # noqa: F401

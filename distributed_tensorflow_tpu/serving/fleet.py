@@ -1,0 +1,1405 @@
+"""Fault-tolerant serving fleet: supervised replicas with no-loss failover.
+
+PRs 7–13 built one excellent single-replica batcher; "millions of users"
+(ROADMAP item 2) means a *fleet*, and the difference between a benchmark
+and a service is what happens when a replica dies mid-decode.  This module
+is that difference, with robustness as the headline contract:
+
+* :class:`ReplicaSet` runs N ``ContinuousBatcher`` replicas — each with
+  its own ``SlotKVCache`` — behind a least-loaded front-end router.  In
+  wall-clock mode every replica serves on its own thread; with a
+  ``VirtualClock`` the supervisor drives replicas deterministically in id
+  order, so chaos tests are exact, repeatable schedules (the Varuna
+  lesson, arXiv:2111.04007: preemption tolerance must be a first-class,
+  testable design axis).
+
+* The :class:`RequestJournal` records every request's replica assignment
+  and every token actually delivered.  When a replica fails — an
+  exception out of its run loop, a watchdog stall, or an injected fault —
+  its queued AND in-flight requests are requeued to surviving replicas
+  with bounded retry + backoff, and the journal's **assignment fence**
+  makes delivery exactly-once: an emission is accepted only from the
+  request's CURRENT replica, so a stalled zombie waking up after failover
+  cannot re-emit (fenced emissions are counted, never delivered).  A
+  retried request resumes by re-prefilling prompt + already-emitted
+  prefix (greedy decode makes the continuation exact — the vLLM
+  iteration-level substrate, arXiv:2309.06180: the retry re-enters the
+  continuous-batching loop of the survivor, it does not restart a batch),
+  and its TTFT stays charged from the ORIGINAL arrival, the PR 7/11
+  accounting discipline.
+
+* :class:`FaultInjector` is the seeded test substrate (the serving twin
+  of ``HealthConfig.inject_nan_at``): crash-at-site-k (decode iteration,
+  prefill chunk, or between verify and commit), stall-for-s (caught by
+  the supervisor's watchdog), and nonfinite-logits corruption — modeled
+  as an out-of-range sampled token id, detected by the fleet's cheap
+  per-token host check before anything reaches the journal.
+
+* **Graceful drain + zero-downtime weight hot-swap**: each replica
+  carries a ``LeaseManager`` (the PR 9 ``should_stop`` contract) whose
+  programmatic ``trigger`` drains it — stop admitting, finish in-flight —
+  after which ``SlotKVCache.swap_params`` installs the new weights
+  between compiled-program dispatches (a swap never recompiles).  Swaps
+  run replica-by-replica, so the fleet never drops below N−1 admitting
+  replicas, and ``swap_generations`` counts completed fleet-wide swaps.
+
+* Fleet accounting: per-replica ``MetricsRegistry`` histograms merge via
+  PR 11's ``merge`` (built for exactly this aggregation), and the run
+  summary carries a ``serve_fleet`` section — replicas, failovers,
+  retries, requeued_requests, duplicate_emissions (== 0 is the
+  exactly-once claim, measured not assumed), swap_generations, and
+  per-replica + merged goodput — plus the two gated headline keys
+  ``serve_failover_recovery_p95_s`` and ``serve_duplicate_emissions``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+from distributed_tensorflow_tpu.elastic.lease import LeaseManager
+from distributed_tensorflow_tpu.observability.metrics import (
+    MetricsRegistry, exact_percentile)
+from distributed_tensorflow_tpu.observability.trace import NULL_TRACER
+from distributed_tensorflow_tpu.serving.kv_cache import SlotKVCache
+from distributed_tensorflow_tpu.serving.scheduler import (
+    ContinuousBatcher, Request, RequestQueue, RequestResult, VirtualClock,
+    WallClock)
+
+
+class InjectedFault(RuntimeError):
+    """A FaultInjector fired: the replica's run loop dies here exactly the
+    way an un-injected bug would — the supervisor must not special-case
+    it (the whole point of injection is exercising the real path)."""
+
+
+class CorruptionDetected(RuntimeError):
+    """The fleet's cheap per-token host check rejected an emission (token
+    id out of [0, vocab) — what nonfinite logits degrade sampling into).
+    Raised BEFORE the journal records anything, so a corrupt token is
+    never delivered; the replica fails over like any other death."""
+
+
+# ------------------------------------------------------------ fault specs
+
+_FAULT_KINDS = ("crash", "stall", "nanlogits")
+_FAULT_SITES = ("decode", "prefill", "verify")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One seeded fault: ``kind`` at the ``at``-th ``site`` event on
+    ``replica`` (1-based count of decode iterations / prefill programs /
+    verify steps on that replica), or Bernoulli per event with ``prob``
+    under the injector's seed.  ``stall_s`` is the stall duration."""
+
+    kind: str
+    replica: int
+    site: str = "decode"
+    at: int = 0
+    prob: float = 0.0
+    stall_s: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in _FAULT_KINDS:
+            raise ValueError(f"fault kind must be one of {_FAULT_KINDS}, "
+                             f"got '{self.kind}'")
+        if self.site not in _FAULT_SITES:
+            raise ValueError(f"fault site must be one of {_FAULT_SITES}, "
+                             f"got '{self.site}'")
+        if self.replica < 0:
+            raise ValueError(f"fault replica must be >= 0, "
+                             f"got {self.replica}")
+        if (self.at <= 0) == (self.prob <= 0.0):
+            raise ValueError(
+                "a fault needs exactly one trigger: at=K (the K-th site "
+                "event) or prob=P (seeded Bernoulli per event); got "
+                f"at={self.at}, prob={self.prob}")
+        if self.kind == "stall" and self.stall_s <= 0:
+            raise ValueError("stall faults need stall_s > 0")
+        if self.site != "decode" and self.kind != "crash":
+            raise ValueError(
+                f"site '{self.site}' supports crash only (stall/nanlogits "
+                f"model decode-path failures)")
+
+
+class FaultInjector:
+    """Seeded fault injection over a replica's SlotKVCache programs.
+
+    ``spec`` is a list of :class:`FaultSpec` or the CLI string grammar
+    (``--serve-fault-spec``)::
+
+        kind:key=val,key=val[;kind:...]
+
+    e.g. ``crash:replica=0,iter=3`` (crash replica 0's 3rd decode
+    iteration — a speculative verify round counts as one iteration, so
+    spec-decoding replicas are killable too),
+    ``crash:replica=1,prefill=2`` (during its 2nd prefill
+    program — the kill-during-prefill-chunk case),
+    ``crash:replica=0,verify=1`` (AFTER the verify step computed, BEFORE
+    any commit — the kill-between-verify-and-commit case),
+    ``stall:replica=1,iter=2,stall_s=0.5``, ``nanlogits:replica=0,iter=4``,
+    ``crash:replica=0,prob=0.05`` (seeded Bernoulli per iteration).
+
+    ``arm(replica_id, kv)`` wraps the instance's ``advance`` /
+    ``insert``+``prefill_chunk`` / ``verify_block`` methods; every firing
+    is recorded in ``fired`` with its site count.  One-shot per spec.
+    """
+
+    def __init__(self, spec: str | Iterable[FaultSpec], seed: int = 0):
+        self.specs = (self.parse(spec) if isinstance(spec, str)
+                      else list(spec))
+        self._rng = np.random.default_rng(seed)
+        self.seed = int(seed)
+        self.fired: list[dict[str, Any]] = []
+        self._done: set[int] = set()   # indices of one-shot specs fired
+
+    @staticmethod
+    def parse(spec: str) -> list[FaultSpec]:
+        """CLI grammar → FaultSpec list (raises ValueError on any typo —
+        the harness validates this pre-train, like every other serve
+        flag)."""
+        out: list[FaultSpec] = []
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            kind, colon, body = part.partition(":")
+            kind = kind.strip()
+            if not colon or kind not in _FAULT_KINDS:
+                raise ValueError(
+                    f"--serve-fault-spec entries are 'kind:key=val,...' "
+                    f"with kind in {_FAULT_KINDS}; got '{part}'")
+            kw: dict[str, Any] = {"kind": kind, "replica": -1}
+            for item in body.split(","):
+                item = item.strip()
+                if not item:
+                    continue
+                key, eq, val = item.partition("=")
+                key = key.strip()
+                val = val.strip()
+                if not eq:
+                    raise ValueError(
+                        f"--serve-fault-spec items must be key=val, got "
+                        f"'{item}'")
+                try:
+                    if key == "replica":
+                        kw["replica"] = int(val)
+                    elif key == "iter":
+                        kw["site"], kw["at"] = "decode", int(val)
+                    elif key == "prefill":
+                        kw["site"], kw["at"] = "prefill", int(val)
+                    elif key == "verify":
+                        kw["site"], kw["at"] = "verify", int(val)
+                    elif key == "prob":
+                        kw["prob"] = float(val)
+                    elif key == "stall_s":
+                        kw["stall_s"] = float(val)
+                    else:
+                        raise ValueError(
+                            f"unknown --serve-fault-spec key '{key}' "
+                            f"(replica/iter/prefill/verify/prob/stall_s)")
+                except ValueError as e:
+                    if "fault-spec" in str(e):
+                        raise
+                    raise ValueError(
+                        f"--serve-fault-spec value for '{key}' must be "
+                        f"numeric, got '{val}'") from None
+            if kw["replica"] < 0:
+                raise ValueError(
+                    f"--serve-fault-spec entry '{part}' needs replica=N")
+            out.append(FaultSpec(**kw))
+        if not out:
+            raise ValueError("--serve-fault-spec parsed to no faults")
+        return out
+
+    # ------------------------------------------------------------- arming
+    def _check(self, replica: int, site: str, count: int) -> FaultSpec | None:
+        """The fault (if any) firing at this site event; one-shot specs
+        fire at most once, prob specs draw from the injector's seeded rng
+        (one draw per matching event — deterministic given the seed and
+        the event schedule)."""
+        for i, s in enumerate(self.specs):
+            if s.replica != replica or s.site != site or i in self._done:
+                continue
+            hit = (count == s.at) if s.at else \
+                (float(self._rng.random()) < s.prob)
+            if hit:
+                self._done.add(i)
+                self.fired.append({"kind": s.kind, "replica": replica,
+                                   "site": site, "count": count,
+                                   "stall_s": s.stall_s or None})
+                return s
+        return None
+
+    def arm(self, replica_id: int, kv: SlotKVCache) -> None:
+        """Wrap this table's device-program entry points.  Instance-level
+        wrappers: the class and every other table stay untouched."""
+        if not any(s.replica == replica_id for s in self.specs):
+            return
+        counts = {"decode": 0, "prefill": 0, "verify": 0}
+        injector = self
+
+        orig_advance = kv.advance
+        orig_insert = kv.insert
+        orig_chunk = kv.prefill_chunk
+        orig_verify = kv.verify_block
+
+        def advance(only=None):
+            if only is None:   # draft catch-up steps are not iterations
+                counts["decode"] += 1
+                s = injector._check(replica_id, "decode", counts["decode"])
+                if s is not None:
+                    if s.kind == "crash":
+                        raise InjectedFault(
+                            f"injected crash: replica {replica_id} decode "
+                            f"iteration {counts['decode']}")
+                    if s.kind == "stall":
+                        time.sleep(s.stall_s)
+                    elif s.kind == "nanlogits":
+                        toks = orig_advance(only)
+                        bad = np.asarray(toks).copy()
+                        # what NaN logits degrade argmax sampling into: an
+                        # id no vocabulary contains — the fleet's host
+                        # check rejects it before delivery
+                        bad[:] = -1
+                        return bad
+            return orig_advance(only)
+
+        def _prefill_gate():
+            counts["prefill"] += 1
+            s = injector._check(replica_id, "prefill", counts["prefill"])
+            if s is not None:
+                raise InjectedFault(
+                    f"injected crash: replica {replica_id} prefill "
+                    f"program {counts['prefill']}")
+
+        def insert(prompt, slot=None):
+            _prefill_gate()
+            return orig_insert(prompt, slot)
+
+        def prefill_chunk(slot, max_tokens=None):
+            _prefill_gate()
+            return orig_chunk(slot, max_tokens)
+
+        def verify_block(block):
+            # a speculative round's verify IS the target decode iteration
+            # (draft-k → verify-1): decode-site faults count and fire
+            # here too, or a spec-decoding replica would be unkillable
+            # by `iter=K`
+            counts["decode"] += 1
+            s = injector._check(replica_id, "decode", counts["decode"])
+            corrupt = False
+            if s is not None:
+                if s.kind == "crash":
+                    raise InjectedFault(
+                        f"injected crash: replica {replica_id} decode "
+                        f"iteration {counts['decode']} (verify round)")
+                if s.kind == "stall":
+                    time.sleep(s.stall_s)
+                corrupt = s.kind == "nanlogits"
+            g = orig_verify(block)
+            counts["verify"] += 1
+            sv = injector._check(replica_id, "verify", counts["verify"])
+            if sv is not None:
+                # AFTER the verify program ran, BEFORE any commit_block:
+                # the kill-between-verify-and-commit window — nothing of
+                # this round may survive into the emitted stream
+                raise InjectedFault(
+                    f"injected crash: replica {replica_id} between verify "
+                    f"{counts['verify']} and commit")
+            if corrupt:
+                g = np.asarray(g).copy()
+                g[:] = -1
+            return g
+
+        kv.advance = advance
+        kv.insert = insert
+        kv.prefill_chunk = prefill_chunk
+        kv.verify_block = verify_block
+
+
+# --------------------------------------------------------------- journal
+
+@dataclasses.dataclass
+class _Entry:
+    """One offered request's journal record (journal lock held for every
+    mutation)."""
+
+    req: Request
+    status: str = "pending"   # pending | done | shed | lost | unserved
+    replica: int | None = None
+    attempts: int = 0
+    emitted: list[int] = dataclasses.field(default_factory=list)
+    emit_t: list[float] = dataclasses.field(default_factory=list)
+    assigned_t: float = 0.0
+    first_assigned_t: float | None = None
+    failed_at: float | None = None   # set at its replica's failure, until
+    #                                  the first post-requeue emission
+    completed_by: int | None = None
+    finish_t: float | None = None
+
+
+class RequestJournal:
+    """Assignment + emission ledger: the exactly-once substrate.
+
+    Every token delivery flows through :meth:`emit`, which accepts an
+    emission only from the request's CURRENT replica assignment (the
+    fence): after failover, a zombie replica's late emissions are counted
+    (``fenced_emissions``) and dropped, never delivered.  A request
+    completes when its emitted stream reaches ``max_new_tokens`` (or its
+    EOS) — the same rule the batchers apply — so journal state and
+    replica state cannot disagree about doneness.
+
+    ``duplicate_emissions`` counts deliveries that would repeat an
+    already-delivered position; the fence makes this structurally zero,
+    and the counter measures it instead of assuming it (the chaos
+    acceptance gate).
+    """
+
+    def __init__(self, requests: Iterable[Request]):
+        self._lock = threading.RLock()
+        self.entries: dict[int, _Entry] = {}
+        self.load: dict[int, int] = {}    # replica -> live assigned count
+        self.fenced_emissions = 0
+        self.duplicate_emissions = 0
+        self.done_count = 0               # O(1) completion counter (the
+        #                                   swap-threshold check runs on
+        #                                   every completion — a counts()
+        #                                   scan there would be O(n²))
+        self.requeues = 0                 # re-assignments (retries)
+        self.requeued_rids: set[int] = set()
+        self.recovery_s: list[float] = []
+        for req in requests:
+            if req.rid in self.entries:
+                raise ValueError(f"duplicate rid {req.rid} in workload")
+            self.entries[req.rid] = _Entry(req=req)
+
+    # ------------------------------------------------------------ routing
+    def assign(self, rid: int, replica: int, t: float,
+               retry: bool = False) -> None:
+        with self._lock:
+            e = self.entries[rid]
+            if e.replica is not None:
+                self.load[e.replica] = self.load.get(e.replica, 1) - 1
+            e.replica = replica
+            e.attempts += 1
+            e.assigned_t = t
+            if e.first_assigned_t is None:
+                e.first_assigned_t = t
+            self.load[replica] = self.load.get(replica, 0) + 1
+            if retry:
+                self.requeues += 1
+                self.requeued_rids.add(rid)
+
+    def least_loaded(self, replicas: Iterable[int]) -> int:
+        """Front-end routing: the serving replica with the fewest live
+        assignments (ties → lowest id, so routing is deterministic)."""
+        with self._lock:
+            return min(replicas,
+                       key=lambda r: (self.load.get(r, 0), r))
+
+    # ----------------------------------------------------------- emission
+    def emit(self, rid: int, replica: int, token: int,
+             t: float) -> tuple[bool, bool, float | None]:
+        """Record one token delivery; returns ``(accepted, completed_now,
+        recovery_s)``.  ``accepted`` False = fenced (stale assignment or
+        already-terminal request) — the caller must NOT deliver."""
+        with self._lock:
+            e = self.entries.get(rid)
+            if e is None:
+                self.fenced_emissions += 1
+                return False, False, None
+            if e.status != "pending" or e.replica != replica:
+                self.fenced_emissions += 1
+                return False, False, None
+            if len(e.emitted) >= e.req.max_new_tokens:
+                # structurally unreachable (completion flips status); a
+                # hit here is a real double-delivery — measured, not
+                # assumed away
+                self.duplicate_emissions += 1
+                return False, False, None
+            e.emitted.append(int(token))
+            e.emit_t.append(float(t))
+            recovery = None
+            if e.failed_at is not None:
+                recovery = float(t) - e.failed_at
+                self.recovery_s.append(recovery)
+                e.failed_at = None
+            done = (len(e.emitted) >= e.req.max_new_tokens
+                    or (e.req.eos_id is not None
+                        and int(token) == e.req.eos_id))
+            if done:
+                e.status = "done"
+                e.completed_by = replica
+                e.finish_t = float(t)
+                self.done_count += 1
+                self.load[replica] = self.load.get(replica, 1) - 1
+            return True, done, recovery
+
+    # ----------------------------------------------------------- failover
+    def pending_for(self, replica: int) -> list[int]:
+        with self._lock:
+            return sorted(rid for rid, e in self.entries.items()
+                          if e.status == "pending" and e.replica == replica)
+
+    def mark_failed(self, rids: Iterable[int], t: float) -> None:
+        """Atomically fence a dead replica's requests: the assignment is
+        CLEARED here (under the journal lock), so a zombie emission
+        racing the failover — after the supervisor decided to fail over
+        but before the requeue lands — is already stale.  Without this,
+        such an emission would record a near-zero bogus recovery sample
+        and could complete the stream mid-handoff."""
+        with self._lock:
+            for rid in rids:
+                e = self.entries[rid]
+                if e.status != "pending":
+                    continue
+                if e.failed_at is None:
+                    e.failed_at = float(t)
+                if e.replica is not None:
+                    self.load[e.replica] = self.load.get(e.replica, 1) - 1
+                    e.replica = None
+
+    def retry_request(self, rid: int) -> Request | None:
+        """The resume request for a failed-over rid: original prompt +
+        already-emitted prefix re-prefilled, remaining budget only —
+        greedy decode makes the continuation exactly what the dead
+        replica would have produced.  None when the stream is already
+        complete (crash after the last emission: nothing to resume)."""
+        with self._lock:
+            e = self.entries[rid]
+            if e.status != "pending":
+                return None   # completed/terminal while failing over
+            remaining = e.req.max_new_tokens - len(e.emitted)
+            if remaining <= 0:
+                # crash landed after the last delivery: the stream is
+                # complete, attributed to the replica that finished it
+                e.status = "done"
+                e.completed_by = e.replica
+                e.finish_t = e.emit_t[-1] if e.emit_t else None
+                self.done_count += 1
+                if e.replica is not None:
+                    self.load[e.replica] = self.load.get(e.replica, 1) - 1
+                return None
+            prompt = np.concatenate([
+                np.asarray(e.req.prompt, np.int32).reshape(-1),
+                np.asarray(e.emitted, np.int32)])
+            return Request(rid=rid, prompt=prompt,
+                           max_new_tokens=remaining,
+                           arrival_s=e.req.arrival_s,
+                           eos_id=e.req.eos_id)
+
+    def finalize(self, rid: int, status: str) -> None:
+        """Terminal non-completion states: shed / lost / unserved."""
+        with self._lock:
+            e = self.entries[rid]
+            if e.status == "pending":
+                e.status = status
+                if e.replica is not None:
+                    self.load[e.replica] = self.load.get(e.replica, 1) - 1
+
+    def finalize_if_assigned(self, rid: int, replica: int,
+                             status: str) -> None:
+        """Fenced finalize: only the request's CURRENT replica may
+        terminal-ize it (a zombie's shed report must not kill a request
+        a survivor now owns — same fence as emission)."""
+        with self._lock:
+            e = self.entries.get(rid)
+            if e is not None and e.status == "pending" \
+                    and e.replica == replica:
+                e.status = status
+                self.load[replica] = self.load.get(replica, 1) - 1
+
+    # ----------------------------------------------------------- summary
+    def all_terminal(self) -> bool:
+        with self._lock:
+            return all(e.status != "pending"
+                       for e in self.entries.values())
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            c = {"done": 0, "shed": 0, "lost": 0, "unserved": 0,
+                 "pending": 0}
+            for e in self.entries.values():
+                c[e.status] += 1
+            return c
+
+    def results(self) -> list[RequestResult]:
+        """Fleet-level per-request results from the journal's emission
+        timeline: TTFT from the ORIGINAL arrival (retries do not reset
+        the clock — the PR 7/11 accounting discipline), ITL gaps from
+        consecutive delivery times (a failover's recovery gap lands in
+        the retried request's own ITL tail, where its reader felt it)."""
+        with self._lock:
+            out = []
+            for rid in sorted(self.entries):
+                e = self.entries[rid]
+                if e.status != "done" or not e.emit_t:
+                    continue
+                lp = int(np.asarray(e.req.prompt).reshape(-1).shape[0])
+                r = RequestResult(
+                    rid=rid, prompt_len=lp, tokens=list(e.emitted),
+                    arrival_s=e.req.arrival_s,
+                    admitted_s=(e.first_assigned_t
+                                if e.first_assigned_t is not None
+                                else e.req.arrival_s),
+                    first_token_s=e.emit_t[0],
+                    finished_s=e.emit_t[-1],
+                    itl_s=[b - a for a, b in zip(e.emit_t, e.emit_t[1:])],
+                    queue_wait_s=max(
+                        (e.first_assigned_t or e.req.arrival_s)
+                        - e.req.arrival_s, 0.0),
+                    prefill_s=max(e.emit_t[0]
+                                  - (e.first_assigned_t
+                                     or e.req.arrival_s), 0.0))
+                out.append(r)
+            return out
+
+
+# ---------------------------------------------------------- shared clock
+
+class _SharedClock:
+    """One fleet-wide clock behind every replica's batcher: ``start`` is
+    idempotent (each ``ContinuousBatcher.run`` calls it; only the first
+    may zero the timeline) and virtual mutations are serialized — the
+    fleet timeline is shared state, per-replica restarts must not rewind
+    it."""
+
+    def __init__(self, base):
+        self._base = base
+        self._lock = threading.Lock()
+        self._started = False
+        self.poll_slice_s = getattr(base, "poll_slice_s", float("inf"))
+
+    def start(self) -> None:
+        with self._lock:
+            if not self._started:
+                self._base.start()
+                self._started = True
+
+    def now(self) -> float:
+        return self._base.now()
+
+    def on_decode_iteration(self) -> None:
+        with self._lock:
+            self._base.on_decode_iteration()
+
+    def on_prefill(self, tokens: int) -> None:
+        with self._lock:
+            self._base.on_prefill(tokens)
+
+    def wait_until(self, t: float) -> None:
+        self._base.wait_until(t)
+
+
+class _FleetQueue(RequestQueue):
+    """RequestQueue whose mutations are lock-guarded, so the supervisor
+    can requeue a failed replica's requests INTO a survivor's live run —
+    the retry re-enters the continuous-batching loop between decode
+    iterations instead of waiting for the survivor's batch to drain."""
+
+    def __init__(self, requests=()):
+        super().__init__(requests)
+        self._qlock = threading.RLock()
+
+    def push(self, request):
+        with self._qlock:
+            super().push(request)
+
+    def __len__(self):
+        with self._qlock:
+            return super().__len__()
+
+    def next_arrival(self):
+        with self._qlock:
+            return super().next_arrival()
+
+    def pop_ready(self, now):
+        with self._qlock:
+            return super().pop_ready(now)
+
+    def depth(self, now=None):
+        with self._qlock:
+            return super().depth(now)
+
+    def shed_ready(self, now, keep):
+        with self._qlock:
+            return super().shed_ready(now, keep)
+
+    def drain(self) -> list[Request]:
+        with self._qlock:
+            items, self._items = list(self._items), []
+            return items
+
+
+# ---------------------------------------------------------------- replica
+
+class _Replica:
+    """Supervisor-side record of one batcher replica."""
+
+    def __init__(self, rid: int, kv: SlotKVCache,
+                 registry: MetricsRegistry):
+        self.id = rid
+        self.kv = kv
+        self.batcher: ContinuousBatcher | None = None  # set by ReplicaSet
+        self.registry = registry
+        self.lease = LeaseManager(signals=())   # trigger()-driven only
+        self.queue = _FleetQueue()
+        self.state = "serving"                  # serving | failed
+        self.generation = 0                     # weight-swap count
+        self.busy = False
+        self.completed = 0
+        self.failure: str | None = None
+        self.last_progress = time.monotonic()
+        self.work = threading.Event()
+        self.stop = threading.Event()
+        self.thread: threading.Thread | None = None
+
+
+class ReplicaSet:
+    """N-replica serving fleet supervisor (module docstring).
+
+    ``kvs`` is one ``SlotKVCache`` per replica (each replica owns its
+    table; params may share device buffers).  ``clock`` is fleet-wide:
+    ``WallClock`` (default) serves every replica on its own thread;
+    ``VirtualClock`` drives replicas sequentially in id order —
+    deterministic chaos schedules (``threaded`` overrides the default).
+
+    ``fault_injector`` arms seeded faults on the matching replicas'
+    tables before serving.  ``watchdog_timeout_s`` (threaded mode) fails
+    over a replica whose scheduler loop made no heartbeat for that long
+    while busy — the heartbeat ticks at every loop iteration and idle
+    poll slice (``_replica_should_stop``), so a replica idling toward a
+    future arrival is NOT a stall; one wedged inside a device program
+    is.  The zombie is fenced, not killed: its late emissions are
+    rejected by the journal.  The watchdog still cannot tell a stall
+    from a first-program XLA compile (the host blocks inside the same
+    call), so set the timeout above worst-case compile time or warm the
+    tables before serving (``bench.py --serve`` warms; the harness's
+    post-train window compiles in its first requests).
+
+    ``retry_limit`` bounds per-request failover attempts (assignments
+    beyond the first), with ``retry_backoff_s`` exponential arrival
+    backoff; an exhausted request is terminal ``lost`` and counts into
+    ``unserved_requests`` (conservation stays exact).
+    """
+
+    def __init__(self, kvs: list[SlotKVCache], *, tracer=NULL_TRACER,
+                 clock=None, threaded: bool | None = None,
+                 prefill_chunk: int = 0, queue_cap: int = 0, slo=None,
+                 draft_kvs: list[SlotKVCache] | None = None,
+                 draft_k: int = 4, retry_limit: int = 2,
+                 retry_backoff_s: float = 0.0,
+                 watchdog_timeout_s: float = 0.0,
+                 fault_injector: FaultInjector | None = None):
+        if not kvs:
+            raise ValueError("ReplicaSet needs at least one SlotKVCache")
+        if draft_kvs is not None and len(draft_kvs) != len(kvs):
+            raise ValueError(
+                f"draft_kvs must pair replicas 1:1 ({len(draft_kvs)} "
+                f"drafts vs {len(kvs)} replicas)")
+        if retry_limit < 0:
+            raise ValueError(f"retry_limit must be >= 0, got {retry_limit}")
+        self.tracer = tracer
+        base_clock = clock if clock is not None else WallClock()
+        self.clock = _SharedClock(base_clock)
+        if threaded is None:
+            threaded = not isinstance(base_clock, VirtualClock)
+        self.threaded = bool(threaded)
+        self.slo = slo
+        self.retry_limit = int(retry_limit)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.watchdog_timeout_s = float(watchdog_timeout_s)
+        self.fault_injector = fault_injector
+        self.vocab = int(kvs[0].dm.vocab_size)
+        self.draft_kvs = draft_kvs
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self.replicas: list[_Replica] = []
+        for i, kv in enumerate(kvs):
+            registry = MetricsRegistry()
+            replica = _Replica(i, kv, registry)
+            replica.batcher = ContinuousBatcher(
+                kv, tracer=tracer, clock=self.clock, mode="continuous",
+                prefill_chunk=prefill_chunk, metrics=registry,
+                queue_cap=queue_cap,
+                should_stop=(lambda iters, r=replica:
+                             self._replica_should_stop(r, iters)),
+                draft_kv=(draft_kvs[i] if draft_kvs is not None else None),
+                draft_k=draft_k)
+            self.replicas.append(replica)
+            if fault_injector is not None:
+                fault_injector.arm(i, kv)
+        # swap state survives _reset_run_state: schedule_swap may be
+        # called BEFORE run(), and generations accumulate across windows
+        self.swap_generations = 0
+        self._swap: dict[str, Any] | None = None
+        self._draining = 0
+        # fleet-level ledgers, reset per run()
+        self._reset_run_state()
+
+    # ------------------------------------------------------------- state
+    def _reset_run_state(self) -> None:
+        self.journal: RequestJournal | None = None
+        self.min_admitting_replicas: int | None = None
+        self._failovers: list[dict[str, Any]] = []
+        self._watchdog_stalls = 0
+        self._preempted: str | None = None
+        self._on_token: Callable[[int, int], None] | None = None
+        self._sums: dict[str, float] = {}
+        self._spec_sums: dict[str, int] = {}
+        self._prefix_sums: dict[str, int] = {}
+        self._phase_sums: dict[str, float] = {}
+        self._shed_count = 0
+        self._run_summaries = 0
+
+    def _serving(self) -> list[_Replica]:
+        return [r for r in self.replicas if r.state == "serving"]
+
+    def _note_admitting(self) -> None:
+        """Track the fleet's minimum admitting-replica count (serving and
+        not draining) — the zero-downtime claim is measured, not assumed."""
+        admitting = len(self._serving()) - self._draining
+        if (self.min_admitting_replicas is None
+                or admitting < self.min_admitting_replicas):
+            self.min_admitting_replicas = admitting
+
+    def _replica_should_stop(self, replica: _Replica,
+                             iters: int) -> str | None:
+        """The per-replica drain hook — and the watchdog's heartbeat:
+        the batcher consults it at every scheduler-loop iteration AND
+        every idle poll slice, so a replica legitimately idling toward a
+        future arrival keeps ticking while one wedged inside a device
+        program (or an injected stall) freezes — exactly the distinction
+        `busy` alone cannot make."""
+        replica.last_progress = time.monotonic()
+        return replica.lease.should_stop(iters)
+
+    # ------------------------------------------------------------ routing
+    def _route(self, req: Request, retry: bool = False,
+               from_replica: int | None = None,
+               reason: str | None = None) -> bool:
+        """Assign ``req`` to the least-loaded serving replica; False when
+        no replica can take it (the caller marks it lost)."""
+        serving = self._serving()
+        if not serving:
+            return False
+        target = self.replicas[self.journal.least_loaded(
+            [r.id for r in serving])]
+        now = self.clock.now()
+        self.journal.assign(req.rid, target.id, now, retry=retry)
+        if retry:
+            entry = self.journal.entries[req.rid]
+            backoff = (self.retry_backoff_s
+                       * (2 ** max(entry.attempts - 2, 0)))
+            req = dataclasses.replace(
+                req, arrival_s=max(req.arrival_s, now + backoff))
+            self.tracer.event(
+                "requeue", rid=req.rid, from_replica=from_replica,
+                to_replica=target.id, attempt=entry.attempts,
+                arrival_s=entry.req.arrival_s, reason=reason,
+                emitted=len(entry.emitted))
+            self.tracer.counter("requeued_requests")
+        target.queue.push(req)
+        target.work.set()
+        return True
+
+    # ----------------------------------------------------------- emission
+    def _emit_hook(self, replica: _Replica):
+        def hook(rid: int, token: int) -> None:
+            tok = int(token)
+            if tok < 0 or tok >= self.vocab:
+                # the cheap host check: two comparisons per token.  An id
+                # outside the vocabulary is what nonfinite logits degrade
+                # sampling into — fail the replica BEFORE delivery.
+                raise CorruptionDetected(
+                    f"replica {replica.id} emitted token id {tok} outside "
+                    f"[0, {self.vocab}) for rid {rid} — nonfinite-logits "
+                    f"corruption")
+            accepted, done, _recovery = self.journal.emit(
+                rid, replica.id, tok, self.clock.now())
+            replica.last_progress = time.monotonic()
+            if not accepted:
+                return   # fenced: counted by the journal, never delivered
+            if self._on_token is not None:
+                self._on_token(rid, tok)
+            if done:
+                replica.completed += 1
+                with self._cond:
+                    self._maybe_start_swap()
+                    self._cond.notify_all()
+        return hook
+
+    # ----------------------------------------------------------- failover
+    def _on_replica_failure(self, replica: _Replica, exc: BaseException,
+                            kind: str | None = None) -> None:
+        with self._lock:
+            if replica.state == "failed":
+                return   # watchdog + exception can race; first wins
+            replica.state = "failed"
+            replica.failure = f"{type(exc).__name__}: {exc}"
+            self._note_admitting()
+            now = self.clock.now()
+            kind = kind or (
+                "injected" if isinstance(exc, InjectedFault) else
+                "corruption" if isinstance(exc, CorruptionDetected) else
+                "crash")
+            pending = self.journal.pending_for(replica.id)
+            # fence first (a zombie's next emission must already be
+            # stale), then requeue
+            self.journal.mark_failed(pending, now)
+            self.tracer.event("replica_failure", replica=replica.id,
+                              kind=kind, error=replica.failure,
+                              requests=len(pending))
+            self.tracer.counter("replica_failures")
+            self._failovers.append({
+                "replica": replica.id, "kind": kind,
+                "error": replica.failure, "t": now,
+                "requeued": len(pending)})
+            # a failed replica scheduled for a swap must not wedge the
+            # rotation
+            if self._swap is not None and self._swap.get("active") \
+                    == replica.id:
+                self._advance_swap()
+            # queued-but-unadmitted requests still sit in its queue; the
+            # journal assignment is the routing truth either way
+            replica.queue.drain()
+            for rid in pending:
+                self._requeue(rid, replica.id,
+                              reason=f"replica_failure:{kind}")
+            self._cond.notify_all()
+
+    def _requeue(self, rid: int, from_replica: int, reason: str) -> None:
+        entry = self.journal.entries[rid]
+        retries_used = max(entry.attempts - 1, 0)
+        if retries_used >= self.retry_limit:
+            self.journal.finalize(rid, "lost")
+            self.tracer.event("retry_exhausted", rid=rid,
+                              attempts=entry.attempts,
+                              limit=self.retry_limit)
+            return
+        req = self.journal.retry_request(rid)
+        if req is None:
+            return   # stream already complete — nothing to resume
+        if not self._route(req, retry=True, from_replica=from_replica,
+                           reason=reason):
+            self.journal.finalize(rid, "lost")
+            self.tracer.event("retry_exhausted", rid=rid,
+                              attempts=entry.attempts,
+                              limit=self.retry_limit,
+                              error="no surviving replica")
+
+    # ---------------------------------------------------------- hot swap
+    def schedule_swap(self, params, draft_params=None, *,
+                      after_completions: int = 0) -> None:
+        """Schedule a zero-downtime weight hot-swap: once
+        ``after_completions`` requests have completed fleet-wide (0 =
+        immediately), replicas drain and swap one at a time — the fleet
+        never drops below N−1 admitting replicas.  Call before or during
+        ``run``; ``swap_generations`` increments when every serving
+        replica carries the new weights."""
+        with self._lock:
+            if self._swap is not None:
+                raise RuntimeError("a weight swap is already in flight")
+            self._swap = {"params": params, "draft_params": draft_params,
+                          "after": int(after_completions),
+                          "queue": None, "active": None}
+            self._maybe_start_swap()
+
+    def _maybe_start_swap(self) -> None:
+        sw = self._swap
+        if sw is None or sw["queue"] is not None or self.journal is None:
+            return
+        if self.journal.done_count < sw["after"]:
+            return
+        sw["queue"] = [r.id for r in self._serving()]
+        self._advance_swap()
+
+    def _advance_swap(self) -> None:
+        sw = self._swap
+        if sw is None:
+            return
+        if sw["active"] is not None:
+            self._draining -= 1
+            sw["active"] = None
+        while sw["queue"]:
+            rid = sw["queue"].pop(0)
+            replica = self.replicas[rid]
+            if replica.state != "serving":
+                continue
+            sw["active"] = rid
+            self._draining += 1
+            self._note_admitting()
+            replica.lease.trigger("weight_swap")
+            replica.work.set()
+            return
+        # rotation complete: one whole fleet generation
+        self.swap_generations += 1
+        self.tracer.event("weight_swap_generation",
+                          generation=self.swap_generations)
+        self._swap = None
+        self._cond.notify_all()
+
+    def _finish_pending_swap(self) -> None:
+        """Complete a STARTED swap rotation once serving work is done:
+        every remaining replica is idle, so each turn installs the new
+        weights with nothing in flight.  A trigger can land exactly as
+        the active replica's run loop empties — the run then exits
+        without the drain marker, and without this sweep the rotation
+        would stall one replica short of a generation."""
+        for _ in range(len(self.replicas) + 1):
+            with self._lock:
+                sw = self._swap
+                if sw is None or sw.get("queue") is None \
+                        or sw.get("active") is None:
+                    return
+                active = self.replicas[sw["active"]]
+            self._perform_swap(active)
+
+    def _perform_swap(self, replica: _Replica) -> None:
+        """The drained replica installs the new weights between compiled-
+        program dispatches and resumes serving on the same lease."""
+        with self._lock:
+            sw = self._swap
+            if sw is None or sw["active"] != replica.id:
+                return
+            replica.kv.swap_params(sw["params"])
+            if self.draft_kvs is not None and sw["draft_params"] is not None:
+                self.draft_kvs[replica.id].swap_params(sw["draft_params"])
+            replica.lease.reset_trigger()
+            replica.generation += 1
+            self.tracer.event("weight_swap", replica=replica.id,
+                              generation=replica.generation)
+            self._advance_swap()
+            replica.work.set()
+
+    # --------------------------------------------------------- the loop
+    def _serve_once(self, replica: _Replica) -> None:
+        """One batcher run over the replica's queue; failures fail over,
+        a weight_swap drain performs the swap and leaves the leftover
+        queue for the next run."""
+        replica.busy = True
+        replica.last_progress = time.monotonic()
+        try:
+            summary = replica.batcher.run(
+                replica.queue, on_token=self._emit_hook(replica))
+        except BaseException as e:  # noqa: BLE001 — any death fails over
+            replica.busy = False
+            self._on_replica_failure(replica, e)
+            return
+        replica.busy = False
+        if replica.state == "failed":
+            # a fenced zombie's late summary is not fleet truth: the
+            # watchdog already failed this replica over mid-run, its
+            # requests were requeued, and absorbing would double-count
+            # the ledgers — worse, its shed_rids would finalize requests
+            # a survivor now owns, truncating their streams
+            return
+        self._absorb(replica, summary)
+        if summary.get("preempted") == "weight_swap":
+            self._perform_swap(replica)
+        with self._cond:
+            self._cond.notify_all()
+
+    def _absorb(self, replica: _Replica, s: dict[str, Any]) -> None:
+        """Fold one successful run summary into the fleet ledgers (a run
+        that died contributes nothing here; the journal still has every
+        delivered token)."""
+        with self._lock:
+            self._run_summaries += 1
+            for k in ("decode_iterations", "prefills", "prefill_chunks",
+                      "prefill_tokens", "decode_tokens", "idle_polls"):
+                self._sums[k] = self._sums.get(k, 0) + (s.get(k) or 0)
+            spec = s.get("speculative")
+            if spec:
+                for k in ("proposed_tokens", "accepted_tokens",
+                          "rejected_tokens", "draft_iterations",
+                          "draft_catchup_steps"):
+                    self._spec_sums[k] = (self._spec_sums.get(k, 0)
+                                          + spec.get(k, 0))
+            pc = s.get("prefix_cache")
+            if pc:
+                for k, v in pc.items():
+                    if isinstance(v, int):
+                        self._prefix_sums[k] = (self._prefix_sums.get(k, 0)
+                                                + v)
+            for k, v in (s.get("device_phase_s") or {}).items():
+                self._phase_sums[k] = self._phase_sums.get(k, 0.0) + v
+            self._shed_count += s.get("shed_requests") or 0
+            for rid in s.get("shed_rids") or ():
+                self.journal.finalize_if_assigned(rid, replica.id, "shed")
+
+    # sequential (deterministic) driver -------------------------------
+    def _run_sequential(self, should_stop) -> None:
+        while True:
+            if should_stop is not None and self._preempted is None:
+                reason = should_stop(0)
+                if reason:
+                    self._preempted = reason
+                    break
+            progressed = False
+            for replica in self.replicas:
+                if replica.state != "serving":
+                    continue
+                if self._swap is not None \
+                        and self._swap.get("active") == replica.id \
+                        and not len(replica.queue):
+                    # idle replica's swap turn: nothing in flight to drain
+                    self._perform_swap(replica)
+                if len(replica.queue):
+                    progressed = True
+                    self._serve_once(replica)
+            if self.journal.all_terminal():
+                break
+            if not progressed:
+                # no serving replica holds work but entries are pending —
+                # every assignment points at a corpse (requeue already
+                # exhausted or raced); terminal-ize so conservation holds
+                for rid, e in self.journal.entries.items():
+                    if e.status == "pending":
+                        self.journal.finalize(rid, "lost")
+                break
+
+    # threaded driver --------------------------------------------------
+    def _worker(self, replica: _Replica) -> None:
+        while True:
+            if replica.state != "serving":
+                return
+            if self._preempted is not None:
+                # fleet drain: the current run already finished in-flight
+                # (its lease was triggered); do not restart over the
+                # leftover queue — those are the drain's unserved
+                return
+            with self._lock:
+                if self._swap is not None \
+                        and self._swap.get("active") == replica.id \
+                        and not len(replica.queue) and not replica.busy:
+                    pass_swap = True
+                else:
+                    pass_swap = False
+            if pass_swap:
+                self._perform_swap(replica)
+                continue
+            if replica.stop.is_set():
+                return
+            if not len(replica.queue):
+                replica.work.wait(0.02)
+                replica.work.clear()
+                continue
+            self._serve_once(replica)
+
+    def _watchdog(self) -> None:
+        timeout = self.watchdog_timeout_s
+        while not self._wd_stop.wait(timeout / 4):
+            for replica in self._serving():
+                if replica.busy and (time.monotonic()
+                                     - replica.last_progress) > timeout:
+                    self._watchdog_stalls += 1
+                    # fence + requeue; the zombie thread keeps running
+                    # until it wakes, at which point its lease drains it
+                    # and its emissions are already stale
+                    replica.lease.trigger("watchdog_stall")
+                    self._on_replica_failure(
+                        replica,
+                        TimeoutError(f"no progress for >{timeout}s"),
+                        kind="watchdog_stall")
+
+    # ----------------------------------------------------------- run
+    def run(self, requests: Iterable[Request],
+            on_token: Callable[[int, int], None] | None = None,
+            should_stop: Callable[[int], str | None] | None = None,
+            ) -> dict[str, Any]:
+        """Serve every offered request to terminal state across the
+        fleet; returns the fleet summary (serve-section compatible, plus
+        ``serve_fleet``)."""
+        requests = sorted(requests, key=lambda r: (r.arrival_s, r.rid))
+        self._reset_run_state()
+        for replica in self.replicas:
+            # a previous run's shutdown left stop set; surviving replicas
+            # serve again (failed ones stay dead — state is the gate)
+            replica.stop.clear()
+            replica.work.clear()
+            # fresh per-run histograms: this run's summary must describe
+            # THIS window (the ContinuousBatcher per-run-registry
+            # convention) — the batcher merges its per-run records into
+            # whatever registry it holds, so swap in a new one per run
+            replica.registry = MetricsRegistry()
+            replica.batcher.metrics = replica.registry
+        self.journal = RequestJournal(requests)
+        self._on_token = on_token
+        offered = len(requests)
+        self.min_admitting_replicas = len(self._serving())
+        if self.slo is not None:
+            self.slo.reset()
+        self.clock.start()
+        t_start = self.clock.now()
+        for req in requests:
+            if not self._route(req):
+                self.journal.finalize(req.rid, "lost")
+        with self._lock:
+            self._maybe_start_swap()   # after_completions == 0 case
+        if self.threaded:
+            self._wd_stop = threading.Event()
+            wd = None
+            if self.watchdog_timeout_s > 0:
+                wd = threading.Thread(target=self._watchdog, daemon=True)
+                wd.start()
+            for replica in self._serving():
+                replica.thread = threading.Thread(
+                    target=self._worker, args=(replica,), daemon=True)
+                replica.thread.start()
+            try:
+                with self._cond:
+                    while not self.journal.all_terminal():
+                        if should_stop is not None \
+                                and self._preempted is None:
+                            reason = should_stop(0)
+                            if reason:
+                                self._preempted = reason
+                                for replica in self._serving():
+                                    replica.lease.trigger(reason)
+                                    replica.work.set()
+                        if self._preempted is not None and not any(
+                                r.busy for r in self.replicas):
+                            break
+                        if not self._serving():
+                            break
+                        self._cond.wait(0.05)
+            finally:
+                self._wd_stop.set()
+                for replica in self.replicas:
+                    replica.stop.set()
+                    replica.work.set()
+                for replica in self.replicas:
+                    if replica.thread is not None:
+                        # a stalled zombie may be asleep inside an
+                        # injected fault; it is fenced and daemonized —
+                        # do not hang the fleet on it
+                        replica.thread.join(timeout=1.0)
+                if wd is not None:
+                    wd.join(timeout=1.0)
+        else:
+            self._run_sequential(should_stop)
+        if self._preempted is None:
+            self._finish_pending_swap()
+        # terminal sweep: anything still pending (fleet drain, stop with
+        # no survivors) is unserved — conservation stays exact
+        for rid, e in list(self.journal.entries.items()):
+            if e.status == "pending":
+                self.journal.finalize(rid, "unserved")
+        if self._preempted:
+            self.tracer.event("serve_preempted", reason=self._preempted,
+                              completed=self.journal.counts()["done"],
+                              unserved=self.journal.counts()["unserved"])
+        elapsed = self.clock.now() - t_start
+        return self._summary(offered, elapsed)
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Join worker threads left behind by ``run`` (a fenced zombie —
+        e.g. a stalled replica sleeping through its watchdog failover —
+        keeps running until it wakes; its emissions are already rejected,
+        but a clean shutdown should wait it out rather than let the
+        interpreter tear down under a live XLA dispatch)."""
+        deadline = time.monotonic() + timeout_s
+        for replica in self.replicas:
+            replica.stop.set()
+            replica.work.set()
+        for replica in self.replicas:
+            t = replica.thread
+            if t is not None and t.is_alive():
+                t.join(timeout=max(deadline - time.monotonic(), 0.0))
+
+    # ----------------------------------------------------------- summary
+    def _summary(self, offered: int, elapsed: float) -> dict[str, Any]:
+        journal = self.journal
+        results = journal.results()
+        counts = journal.counts()
+        ttfts = [r.ttft_s for r in results]
+        itls = [g for r in results for g in r.itl_s]
+        tokens = sum(len(e.emitted) for e in journal.entries.values()
+                     if e.emitted)
+        # merged per-replica histograms: the PR 11 aggregation substrate —
+        # windows → runs → FLEET, by bucket-count addition, no resampling
+        merged = MetricsRegistry()
+        for replica in self.replicas:
+            merged.merge(replica.registry)
+        # fleet-level goodput: every completed request judged on its
+        # journal timeline (TTFT from original arrival), per replica and
+        # merged — a retried request counts ONCE, for the replica that
+        # finished it
+        per_replica = []
+        slo = self.slo
+        fleet_good = 0
+        for replica in self.replicas:
+            done = [r for r in results
+                    if journal.entries[r.rid].completed_by == replica.id]
+            good = None
+            if slo is not None:
+                good = sum(
+                    1 for r in done
+                    if r.ttft_s <= slo.ttft_s
+                    and ((exact_percentile(r.itl_s, slo.quantile)
+                          or 0.0) <= slo.itl_s))
+                fleet_good += good
+            per_replica.append({
+                "replica": replica.id,
+                "state": replica.state,
+                "failure": replica.failure,
+                "completed": len(done),
+                "tokens": sum(len(r.tokens) for r in done),
+                "generation": replica.generation,
+                "goodput_requests_per_sec": (
+                    good / elapsed
+                    if good is not None and elapsed > 0 else None),
+            })
+        slo_sec = None
+        if slo is not None:
+            slo.reset()
+            for r in results:
+                slo.observe(r.ttft_s, r.itl_s)
+            slo.shed(counts["shed"])
+            slo_sec = slo.summary(elapsed)
+        recovery = list(journal.recovery_s)
+        unserved = counts["lost"] + counts["unserved"]
+        depth_hwm = max((r.queue.depth_high_watermark
+                         for r in self.replicas), default=0)
+        prefix_sec = None
+        hit_rate = None
+        if self._prefix_sums:
+            prefix_sec = dict(self._prefix_sums)
+            asked = prefix_sec.get("hits", 0) + prefix_sec.get("misses", 0)
+            hit_rate = prefix_sec["hits"] / asked if asked else 0.0
+        spec_sec = None
+        accept_rate = None
+        if self.draft_kvs is not None:
+            spec_sec = dict(self._spec_sums)
+            proposed = spec_sec.get("proposed_tokens", 0)
+            accept_rate = (spec_sec.get("accepted_tokens", 0) / proposed
+                           if proposed else None)
+        qw = merged.histogram("queue_wait")
+        qd = merged.histogram("queue_depth")
+        prefill_tokens = int(self._sums.get("prefill_tokens", 0))
+        decode_tokens = int(self._sums.get("decode_tokens", 0))
+        summary = {
+            "mode": "fleet",
+            "replicas": len(self.replicas),
+            "requests": len(results),
+            "completed": counts["done"],
+            "serve_kv_dtype": self.replicas[0].kv.kv_dtype,
+            "serve_kv_bytes_per_slot":
+                self.replicas[0].kv.kv_bytes_per_slot(),
+            "serve_accept_rate": accept_rate,
+            "speculative": spec_sec,
+            "decode_iterations": int(self._sums.get(
+                "decode_iterations", 0)),
+            "prefills": int(self._sums.get("prefills", 0)),
+            "prefill_chunk": self.replicas[0].batcher.prefill_chunk,
+            "prefill_chunks": int(self._sums.get("prefill_chunks", 0)),
+            "prefill_tokens": prefill_tokens,
+            "decode_tokens": decode_tokens,
+            "idle_polls": int(self._sums.get("idle_polls", 0)),
+            "tokens_generated": tokens,
+            "elapsed_s": elapsed,
+            "serve_requests_per_sec": (counts["done"] / elapsed
+                                       if elapsed > 0 else None),
+            "serve_tokens_per_sec": (tokens / elapsed
+                                     if elapsed > 0 else None),
+            "serve_prefill_tokens_per_sec": (prefill_tokens / elapsed
+                                             if elapsed > 0 else None),
+            "serve_decode_tokens_per_sec": (decode_tokens / elapsed
+                                            if elapsed > 0 else None),
+            "serve_prefix_cache_hit_rate": hit_rate,
+            "prefix_cache": prefix_sec,
+            "serve_ttft_p50_s": exact_percentile(ttfts, 0.50),
+            "serve_ttft_p95_s": exact_percentile(ttfts, 0.95),
+            "serve_ttft_p99_s": exact_percentile(ttfts, 0.99),
+            "serve_itl_p50_s": exact_percentile(itls, 0.50),
+            "serve_itl_p95_s": exact_percentile(itls, 0.95),
+            "serve_itl_p99_s": exact_percentile(itls, 0.99),
+            # attempt-level queue waits from the merged replica histograms
+            # (each admission's claim wait on ITS replica's clock — the
+            # fleet-level TTFT above is the original-arrival number)
+            "serve_queue_wait_p50_s": qw.quantile(0.50),
+            "serve_queue_wait_p95_s": qw.quantile(0.95),
+            "serve_queue_wait_p99_s": qw.quantile(0.99),
+            "queue_depth_p95": qd.quantile(0.95),
+            "queue_depth_high_watermark": depth_hwm,
+            "queue_cap": self.replicas[0].batcher.queue_cap,
+            "offered": offered,
+            "admitted": counts["done"],
+            "shed_requests": counts["shed"],
+            "unserved_requests": unserved,
+            "serve_shed_rate": (counts["shed"] / offered
+                                if offered else 0.0),
+            "preempted": self._preempted,
+            "serve_goodput_under_slo": (
+                (slo_sec or {}).get("goodput_requests_per_sec")
+                if slo_sec else None),
+            "slo": slo_sec,
+            "histograms": merged.snapshot(),
+            "device_phase_s": dict(self._phase_sums),
+            # fleet robustness headline keys (gated by `analyze diff`):
+            # recovery time = replica-failure detection → the failed-over
+            # request's first post-requeue delivery; duplicates == 0 is
+            # the measured exactly-once claim
+            "serve_failover_recovery_p95_s": exact_percentile(
+                recovery, 0.95),
+            "serve_duplicate_emissions": journal.duplicate_emissions,
+            "serve_fleet": {
+                "replicas": len(self.replicas),
+                "serving_replicas": len(self._serving()),
+                "failed_replicas": [r.id for r in self.replicas
+                                    if r.state == "failed"],
+                "failovers": len(self._failovers),
+                "failover_events": self._failovers[:32],
+                "retries": journal.requeues,
+                "requeued_requests": len(journal.requeued_rids),
+                "lost_requests": counts["lost"],
+                "duplicate_emissions": journal.duplicate_emissions,
+                "fenced_emissions": journal.fenced_emissions,
+                "watchdog_stalls": self._watchdog_stalls,
+                "faults_injected": (list(self.fault_injector.fired)
+                                    if self.fault_injector is not None
+                                    else []),
+                "swap_generations": self.swap_generations,
+                "min_admitting_replicas": self.min_admitting_replicas,
+                "failover_recovery_s": recovery[:128],
+                "failover_recovery_p95_s": exact_percentile(
+                    recovery, 0.95),
+                "per_replica": per_replica,
+                "merged_goodput_under_slo": (
+                    fleet_good / elapsed
+                    if slo is not None and elapsed > 0 else None),
+            },
+            "results": results,
+        }
+        return summary
+
+
+# re-exported convenience: a fleet built from one (model, params) pair
+def build_replica_kvs(model, params, n_replicas: int, slots: int,
+                      **kv_kwargs) -> list[SlotKVCache]:
+    """N independent slot tables over shared params (replicated params
+    share device buffers; each replica owns its KV memory).  n == 0 is
+    legal and returns [] — callers extending an already-built first
+    table pass n_replicas - 1."""
+    if n_replicas < 0:
+        raise ValueError(f"n_replicas must be >= 0, got {n_replicas}")
+    return [SlotKVCache(model, params, slots, **kv_kwargs)
+            for _ in range(n_replicas)]
+
+
+__all__ = [
+    "CorruptionDetected",
+    "FaultInjector",
+    "FaultSpec",
+    "InjectedFault",
+    "ReplicaSet",
+    "RequestJournal",
+    "build_replica_kvs",
+]

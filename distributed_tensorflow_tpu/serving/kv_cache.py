@@ -182,22 +182,8 @@ class SlotKVCache:
                     mesh, t.ndim, shard_heads=keep_tp)), cache)
             self._vec_sharding = meshlib.kv_slot_sharding(mesh, 1)
             self._blk_sharding = meshlib.kv_slot_sharding(mesh, 2)
-            # params committed to this mesh are used in place; anything
-            # else replicates (the `generate(mesh=...)` placement rule)
-            repl = NamedSharding(mesh, P())
-            target = mesh.devices.tolist()
-
-            def place(t):
-                sh = getattr(t, "sharding", None)
-                if isinstance(sh, NamedSharding) and (
-                        sh.mesh is mesh
-                        or sh.mesh.devices.tolist() == target):
-                    return t
-                return jax.device_put(t, repl)
-
-            params = jax.tree.map(place, params)
         self.cache = cache
-        self.params = params
+        self.params = self._place_params(params)
 
         # host-side slot table.  ``reserved`` marks slots claimed by an
         # in-progress chunked admission (begin_insert): not free, but not
@@ -235,6 +221,54 @@ class SlotKVCache:
         self._verifies: dict[int, object] = {}         # speculative verify
         self._read_block = None                        # prefix-pool extract
         self._write_block = None                       # prefix-pool restore
+
+    def _place_params(self, params):
+        """Param placement rule (shared by __init__ and ``swap_params``):
+        params committed to this table's mesh are used in place; anything
+        else replicates (the `generate(mesh=...)` placement rule)."""
+        if self.mesh is None:
+            return params
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+        target = mesh.devices.tolist()
+
+        def place(t):
+            sh = getattr(t, "sharding", None)
+            if isinstance(sh, NamedSharding) and (
+                    sh.mesh is mesh
+                    or sh.mesh.devices.tolist() == target):
+                return t
+            return jax.device_put(t, repl)
+
+        return jax.tree.map(place, params)
+
+    def swap_params(self, params) -> None:
+        """Zero-downtime weight hot-swap: replace the served params
+        between compiled-program dispatches (serving/fleet.py drains a
+        replica's in-flight slots first — KV written under the old params
+        must never be decoded under the new ones).  The new tree must
+        match the old one's structure/shapes/dtypes, so every compiled
+        program (decode step, prefill buckets, chunk buckets, verify
+        widths) stays a cache hit — a swap never recompiles."""
+        old = jax.tree_util.tree_structure(self.params)
+        new = jax.tree_util.tree_structure(params)
+        if old != new:
+            raise ValueError(
+                "swap_params needs the same param tree structure as the "
+                "served checkpoint (same model config) — a different "
+                "architecture cannot hot-swap into live slots")
+        mismatch = [
+            f"{jax.tree_util.keystr(path)}: {a.shape}/{a.dtype} vs "
+            f"{b.shape}/{b.dtype}"
+            for (path, a), b in zip(
+                jax.tree_util.tree_flatten_with_path(self.params)[0],
+                jax.tree.leaves(params))
+            if a.shape != b.shape or a.dtype != b.dtype]
+        if mismatch:
+            raise ValueError(
+                f"swap_params shape/dtype mismatch (a swap must be a "
+                f"compiled-program cache hit): {mismatch[:3]}")
+        self.params = self._place_params(params)
 
     # ------------------------------------------------------------- programs
     def _sample(self, logits, rng):

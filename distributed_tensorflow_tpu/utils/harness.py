@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import sys
 import time
 from typing import Any, Callable
 
@@ -294,6 +295,42 @@ class ExperimentConfig:
                                            # target verify; greedy
                                            # acceptance keeps the stream
                                            # bitwise non-speculative)
+    serve_replicas: int = 1                # >1: serve through a ReplicaSet
+                                           # fleet (serving/fleet.py) —
+                                           # N batcher replicas, each with
+                                           # its own serve_slots-slot KV
+                                           # table, behind a least-loaded
+                                           # router with journaled
+                                           # no-loss failover; the serve
+                                           # section gains `serve_fleet`
+                                           # + the failover gate keys
+    serve_fault_spec: str | None = None    # seeded fault injection into
+                                           # the fleet (FaultInjector
+                                           # grammar: 'crash:replica=0,
+                                           # iter=3;stall:replica=1,
+                                           # iter=2,stall_s=1' ...) — the
+                                           # chaos-test substrate; forces
+                                           # the fleet path even at
+                                           # serve_replicas == 1
+    serve_watchdog_s: float = 0.0          # >0: fleet supervisor watchdog
+                                           # — a replica busy with no
+                                           # token progress for this many
+                                           # seconds is failed over (its
+                                           # zombie fenced).  Set it above
+                                           # worst-case first-program XLA
+                                           # compile; 0 = off (stall
+                                           # faults then just sleep).
+                                           # Fleet mode only
+    serve_hot_swap: bool = False           # zero-downtime weight hot-swap
+                                           # drill: after half the window
+                                           # completes, drain + re-install
+                                           # the served params replica-by-
+                                           # replica (never below N-1
+                                           # admitting) — swap_generations
+                                           # >= 1 proves the mechanism,
+                                           # greedy tokens unchanged (the
+                                           # swapped-in weights are the
+                                           # same trained params)
 
 
 def enable_compile_cache(directory: str | os.PathLike) -> str:
@@ -1788,6 +1825,29 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                                           test_ds, tracer, total_devices,
                                           should_stop=serve_stop)
             summary["serve"] = serve_sec
+            # supervisor exit policy: a serve window that lost requests
+            # (unserved > 0 — lease drain, retry exhaustion, dead fleet)
+            # or delivered a duplicate token must not bury it in the
+            # middle of a summary — emit a structured warning event AND
+            # a machine-checkable flag (0 = clean) so CI gates on it
+            violations = []
+            if serve_sec.get("unserved_requests"):
+                violations.append(
+                    f"unserved_requests="
+                    f"{serve_sec['unserved_requests']}")
+            if serve_sec.get("serve_duplicate_emissions"):
+                violations.append(
+                    f"duplicate_emissions="
+                    f"{serve_sec['serve_duplicate_emissions']}")
+            summary["serve_exit_policy"] = 1 if violations else 0
+            if violations:
+                tracer.event("serve_warning", reasons=violations,
+                             preempted=serve_sec.get("preempted"))
+                sink.emit("serve_warning", reasons=violations,
+                          preempted=serve_sec.get("preempted"))
+                print(f"warning: serve window degraded "
+                      f"({', '.join(violations)}); "
+                      f"serve_exit_policy=1", file=sys.stderr)
         # end-of-run report: steady-state percentiles split from compile,
         # chunk shapes actually used, watchdog/prefetch/sink health, and
         # the telemetry's own measured overhead (observability/report) —
@@ -2074,6 +2134,23 @@ def _validate_serving(config: ExperimentConfig, ex: _Experiment,
         parse_draft_config(config.serve_draft_config)
     if config.serve_kv_dtype:
         _resolve_serve_kv_dtype(config.serve_kv_dtype)
+    if config.serve_replicas < 1:
+        raise ValueError(
+            f"--serve-replicas must be >= 1, got {config.serve_replicas}")
+    if config.serve_watchdog_s < 0:
+        raise ValueError(
+            f"--serve-watchdog must be >= 0 (0 = off), got "
+            f"{config.serve_watchdog_s}")
+    if config.serve_fault_spec:
+        # fault grammar + replica bounds checked pre-train, like every
+        # other deterministically-knowable serve flag
+        from distributed_tensorflow_tpu.serving.fleet import FaultInjector
+
+        for fault in FaultInjector.parse(config.serve_fault_spec):
+            if fault.replica >= config.serve_replicas:
+                raise ValueError(
+                    f"--serve-fault-spec targets replica {fault.replica} "
+                    f"but --serve-replicas is {config.serve_replicas}")
     plen = config.serve_prompt_len
     if plen < 1 or plen > test_ds.x.shape[1]:
         raise ValueError(
@@ -2138,6 +2215,13 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
         # payload again (int8 K/V + per-vector f32 scales); token parity
         # vs the bf16 oracle is tolerance-based, not bitwise.
         kv_dtype = _resolve_serve_kv_dtype(config.serve_kv_dtype)
+    # fleet mode (--serve-replicas / --serve-fault-spec / --serve-hot-
+    # swap): N independent slot tables behind the ReplicaSet supervisor —
+    # a fault spec or a hot-swap drill forces the fleet path even at one
+    # replica, so the supervision/journal machinery is what gets tested
+    n_replicas = max(config.serve_replicas, 1)
+    fleet = (n_replicas > 1 or bool(config.serve_fault_spec)
+             or config.serve_hot_swap)
     kv = SlotKVCache(ex.engine.model, params, config.serve_slots,
                      mesh=mesh, kv_dtype=kv_dtype,
                      prefix_cache_blocks=config.serve_prefix_cache,
@@ -2183,12 +2267,53 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
                 prompt=np.concatenate([shared, rows[i % len(rows), :plen]]),
                 max_new_tokens=config.serve_max_new, arrival_s=0.0)
         for i in range(config.serve_requests)]
+    slo = SLOMonitor(config.serve_slo_ttft, config.serve_slo_itl)
+    if fleet:
+        from distributed_tensorflow_tpu.serving.fleet import (
+            FaultInjector, ReplicaSet, build_replica_kvs)
+
+        kvs = [kv] + build_replica_kvs(
+            ex.engine.model, params, n_replicas - 1, config.serve_slots,
+            mesh=mesh, kv_dtype=kv_dtype,
+            prefix_cache_blocks=config.serve_prefix_cache,
+            prefix_block=config.serve_prefix_block)
+        draft_kvs = None
+        if draft_kv is not None:
+            draft_kvs = [draft_kv] + build_replica_kvs(
+                draft_model, draft_params, n_replicas - 1,
+                config.serve_slots, mesh=mesh)
+        injector = (FaultInjector(config.serve_fault_spec,
+                                  seed=config.seed)
+                    if config.serve_fault_spec else None)
+        replica_set = ReplicaSet(
+            kvs, tracer=tracer,
+            prefill_chunk=config.serve_prefill_chunk,
+            queue_cap=config.serve_queue_cap, slo=slo,
+            draft_kvs=draft_kvs, draft_k=config.serve_draft_k,
+            watchdog_timeout_s=config.serve_watchdog_s,
+            fault_injector=injector)
+        if config.serve_hot_swap:
+            # the drill: re-install the SAME trained params after half
+            # the window — proves drain + swap_generations + N-1
+            # availability with greedy tokens unchanged; a real rollout
+            # passes new checkpoint params here
+            replica_set.schedule_swap(
+                params, after_completions=max(config.serve_requests // 2,
+                                              1))
+        with tracer.span("serve", requests=config.serve_requests,
+                         slots=config.serve_slots, replicas=n_replicas):
+            try:
+                summary = replica_set.run(requests,
+                                          should_stop=should_stop)
+            finally:
+                replica_set.close()
+        return serve_section(summary, total_devices)
     with tracer.span("serve", requests=config.serve_requests,
                      slots=config.serve_slots):
         summary = ContinuousBatcher(
             kv, tracer=tracer,
             prefill_chunk=config.serve_prefill_chunk,
-            slo=SLOMonitor(config.serve_slo_ttft, config.serve_slo_itl),
+            slo=slo,
             queue_cap=config.serve_queue_cap,
             should_stop=should_stop,
             draft_kv=draft_kv, draft_k=config.serve_draft_k).run(requests)
