@@ -1220,7 +1220,8 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                 sweep: bool = False, slo_ttft: float | None = None,
                 slo_itl: float | None = None, queue_cap: int = 0,
                 kv_dtype: str | None = None, draft: str | None = None,
-                draft_k: int | None = None, replicas: int = 0) -> None:
+                draft_k: int | None = None, replicas: int = 0,
+                kv_layout: str | None = None) -> None:
     """Serving throughput + latency percentiles of the continuous-batching
     engine (distributed_tensorflow_tpu/serving/) against the static-batch
     restart-per-``generate`` baseline, on the SAME synthetic open-loop
@@ -1313,6 +1314,17 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
     # non-speculative on the same trace)
     kv_dtype = kv_dtype or env("BENCH_SERVE_KV_DTYPE", "") or None
     draft = draft or env("BENCH_SERVE_DRAFT", "") or None
+    # round 16: --serve-kv-layout paged (BENCH_SERVE_KV_LAYOUT) — the
+    # production windows run the paged block pool + fused Pallas decode
+    # attention; the `kv_base` monolithic window on the SAME seeded trace
+    # is then ALSO the paged-vs-monolithic comparison
+    # (paged_vs_monolithic_itl_p95), alongside the pool utilization and
+    # zero-copy ledger keys
+    kv_layout = kv_layout or env("BENCH_SERVE_KV_LAYOUT", "") or "monolithic"
+    if kv_layout not in ("monolithic", "paged"):
+        raise SystemExit(f"BENCH_SERVE_KV_LAYOUT must be 'monolithic' or "
+                         f"'paged', got {kv_layout!r}")
+    paged = kv_layout == "paged"
     if draft_k is None:
         draft_k = int(env("BENCH_SERVE_DRAFT_K", "4"))
     # round 15: --replicas N — fleet mode (serving/fleet.py ReplicaSet):
@@ -1387,16 +1399,21 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
     # dispatches these — skip the construction too (each table allocates
     # the full slots×max_len KV buffers on device)
     kv = kv_base = kv_cmp = None
+    # paged layout applies to the PRODUCTION tables only: kv_base stays
+    # monolithic by construction — it IS the paged-vs-monolithic
+    # comparison window on the same trace
+    layout_kwargs = {"kv_layout": "paged"} if paged else {}
     if not fleet_mode:
         kv = SlotKVCache(model, params, slots, mesh=mesh,
                          kv_dtype=resolved_kv_dtype,
                          prefix_cache_blocks=cache_blocks,
-                         prefix_block=prefix_block)
+                         prefix_block=prefix_block, **layout_kwargs)
         kv_base = SlotKVCache(model, params, slots, mesh=mesh)
         if resolved_kv_dtype is not None:
             kv_cmp = SlotKVCache(model, params, slots, mesh=mesh,
                                  prefix_cache_blocks=cache_blocks,
-                                 prefix_block=prefix_block)
+                                 prefix_block=prefix_block,
+                                 **layout_kwargs)
     # speculative decoding: the draft's own full-precision table, in slot
     # lockstep with `kv` (windows evict everything on exit, so sharing
     # one draft table across windows is safe like sharing `kv`)
@@ -1554,7 +1571,8 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                 t = SlotKVCache(model, params, slots, mesh=mesh,
                                 kv_dtype=resolved_kv_dtype,
                                 prefix_cache_blocks=cache_blocks,
-                                prefix_block=prefix_block)
+                                prefix_block=prefix_block,
+                                **layout_kwargs)
                 # warm THIS table's programs outside the timed windows
                 # (same discipline as _warm: chunk buckets + monolithic
                 # buckets + one pool hit)
@@ -1956,7 +1974,14 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                   # dtype capacity number) + the speculative-decode
                   # accept rate (None without a draft; tokens/sec stays
                   # emitted-tokens-only either way)
-                  "serve_kv_bytes_per_slot", "serve_accept_rate")
+                  "serve_kv_bytes_per_slot", "serve_accept_rate",
+                  # round 16: paged KV pool accounting (None under
+                  # monolithic — the keys exist so `analyze diff` gates
+                  # them when both runs page): physical blocks in use,
+                  # pool utilization, and the fraction of reusable
+                  # prefix blocks shared zero-copy by pointer
+                  "serve_kv_blocks_in_use", "serve_kv_block_utilization",
+                  "serve_prefix_zero_copy_hit_rate")
     line = {k: med(cont, k) for k in serve_keys}
     rps = line["serve_requests_per_sec_per_chip"]
     static_rps = med(stat, "serve_requests_per_sec_per_chip")
@@ -2004,6 +2029,19 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
         "chunked_vs_monolithic_itl_p95": (
             round(line["serve_itl_p95_s"] / mono_itl95, 3)
             if line["serve_itl_p95_s"] and mono_itl95 else None),
+        # round 16: with --serve-kv-layout paged the production windows
+        # page and `kv_base` is the monolithic twin on the SAME seeded
+        # trace — this ratio is THE paged-vs-monolithic latency number
+        # (< 1 = the fused paged kernel beats the monolithic gather);
+        # None under monolithic (the two windows would be the same
+        # layout, a ratio of noise).  The paged section (pool shape +
+        # zero-copy/CoW ledger) comes from the first production window.
+        "paged_vs_monolithic_itl_p95": (
+            round(line["serve_itl_p95_s"] / mono_itl95, 3)
+            if paged and line["serve_itl_p95_s"] and mono_itl95
+            else None),
+        "serve_kv_layout": kv_layout,
+        "paged": cont[0].get("paged"),
         "cached_vs_uncached_ttft_p50": (
             round(line["serve_ttft_p50_s"] / mono_ttft50, 3)
             if line["serve_ttft_p50_s"] and mono_ttft50 else None),
@@ -2032,6 +2070,7 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                    "slo_ttft_s": slo_ttft, "slo_itl_s": slo_itl,
                    "queue_cap": queue_cap,
                    "kv_dtype": kv.kv_dtype,
+                   "kv_layout": kv_layout,
                    "draft": draft, "draft_k": draft_k if draft else None},
         "device": device_kind,
         "n_devices": n,
@@ -2121,6 +2160,18 @@ def main() -> None:
                         "rule) and emits serve_kv_dtype / "
                         "serve_kv_bytes_per_slot + the bytes ratio and "
                         "greedy-token agreement vs that baseline")
+    p.add_argument("--serve-kv-layout", default=None,
+                   choices=["monolithic", "paged"], metavar="LAYOUT",
+                   help="--serve: KV layout for the production windows "
+                        "(default BENCH_SERVE_KV_LAYOUT or monolithic). "
+                        "'paged' runs the refcounted block pool + fused "
+                        "Pallas paged decode attention; the monolithic "
+                        "window on the SAME seeded trace then also "
+                        "yields paged_vs_monolithic_itl_p95, and the "
+                        "line carries serve_kv_blocks_in_use / "
+                        "serve_kv_block_utilization / "
+                        "serve_prefix_zero_copy_hit_rate + the paged "
+                        "pool section")
     p.add_argument("--serve-draft", default=None, metavar="SPEC",
                    help="--serve: speculative decoding for the "
                         "production windows — 'self' (draft = the bench "
@@ -2228,7 +2279,8 @@ def main() -> None:
                         kv_dtype=args.serve_kv_dtype,
                         draft=args.serve_draft,
                         draft_k=args.serve_draft_k,
-                        replicas=args.replicas)
+                        replicas=args.replicas,
+                        kv_layout=args.serve_kv_layout)
         elif mode == "stream":
             bench_stream(steps=max(args.steps, 1),
                          grad_compression=args.grad_compression,

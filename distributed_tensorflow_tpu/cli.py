@@ -244,6 +244,36 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="T",
                    help="--serve: tokens per prefix-cache block (reuse "
                         "granularity; only full blocks are pooled)")
+    p.add_argument("--serve-kv-layout", default="monolithic",
+                   choices=["monolithic", "paged"],
+                   help="--serve: KV storage layout.  'paged' swaps the "
+                        "per-slot max_len rows for ONE refcounted "
+                        "physical block pool + per-slot block tables "
+                        "(vLLM PagedAttention): prefix-cache hits alias "
+                        "pooled blocks by pointer (zero KV bytes "
+                        "copied), first write into a shared block "
+                        "copies on write, and decode/verify read "
+                        "through the table in one fused Pallas kernel "
+                        "(in-kernel int8 dequant; token parity vs the "
+                        "monolithic oracle is tolerance-based — the "
+                        "attention-reassociation caveat, like int8).  "
+                        "Default 'monolithic' keeps the pre-round-16 "
+                        "programs byte-identical")
+    p.add_argument("--serve-paged-block", type=int, default=0,
+                   metavar="T",
+                   help="--serve-kv-layout paged: tokens per physical "
+                        "KV block.  0 (default) inherits --serve-prefix-"
+                        "block; with the prefix pool on the two must "
+                        "agree (hits alias physical blocks by pointer)")
+    p.add_argument("--serve-paged-blocks", type=int, default=0,
+                   metavar="N",
+                   help="--serve-kv-layout paged: physical block-pool "
+                        "capacity.  0 (default) auto-sizes so every "
+                        "slot can reach max_len and the prefix pool can "
+                        "pin its bound — never exhausts; smaller "
+                        "explicit pools defer admissions "
+                        "(serve_kv_block_deferrals) when the free list "
+                        "cannot cover a request's worst-case need")
     p.add_argument("--serve-shared-prefix", type=int, default=0,
                    metavar="T",
                    help="--serve: prepend a fixed T-token synthetic "
@@ -669,6 +699,9 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         serve_fault_spec=args.serve_fault_spec,
         serve_hot_swap=args.serve_hot_swap,
         serve_watchdog_s=args.serve_watchdog,
+        serve_kv_layout=args.serve_kv_layout,
+        serve_paged_block=args.serve_paged_block,
+        serve_paged_blocks=args.serve_paged_blocks,
     )
     summary = run(config)  # run() itself wraps recovery when max_restarts>0
     print(json.dumps(summary))

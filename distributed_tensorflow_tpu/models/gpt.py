@@ -106,9 +106,24 @@ class CausalSelfAttention(nn.Module):
                                # head; parallel/compression.py channel
                                # quantizer), dequantized on the attention
                                # read — the stored table is what shrinks
+    paged_blocks: int = 0      # >0: paged KV layout (decode_slots only).
+                               # The cache becomes ONE physical pool of
+                               # this many (paged_block, kvh, head_dim)
+                               # blocks shared by every slot; the caller
+                               # passes per-slot int32 block tables and
+                               # owns allocation/aliasing/CoW
+                               # (serving/kv_cache.py PagedSlotKVCache)
+    paged_block: int = 16      # tokens per physical block (must divide
+                               # max_len)
+    paged_fused: bool = False  # read the pool through the fused Pallas
+                               # kernel (ops/paged_attention.py) instead
+                               # of gather + dense — the gather path is
+                               # bitwise the monolithic math (prefill /
+                               # oracle); the fused path is the decode
+                               # hot op (tolerance parity)
 
     @nn.compact
-    def __call__(self, x, pos=None):
+    def __call__(self, x, pos=None, block_tables=None):
         head_dim = self.hidden // self.heads
         tp = self.partition_model
         if self.rope and pos is None:
@@ -204,6 +219,27 @@ class CausalSelfAttention(nn.Module):
                         "decode_slots=True needs per-slot positions "
                         "(B, 1) — the serving engine passes the slot "
                         "length vector")
+                if self.paged_blocks:
+                    # PAGED layout (vLLM PagedAttention): the cache
+                    # variables are ONE pool of physical blocks shared by
+                    # all slots + nothing per-slot on device — each row's
+                    # writes scatter through its caller-supplied block
+                    # table, and reads either gather the table back
+                    # (bitwise the monolithic math — the prefill/oracle
+                    # path) or run the fused Pallas kernel that follows
+                    # the table in-kernel (the decode/verify hot op).
+                    # Aliasing is invisible here by design: two tables
+                    # pointing at one block read identical KV, which is
+                    # exactly the zero-copy prefix share.
+                    out = self._paged_attend(x, q, k, v, pos, block_tables,
+                                             widen, kvh, head_dim)
+                    out = out.reshape(out.shape[:-2]
+                                      + (self.heads * head_dim,))
+                    return nn.Dense(
+                        self.hidden, dtype=self.dtype, name="out",
+                        kernel_init=_part(
+                            nn.initializers.lecun_normal(),
+                            (meshlib.MODEL_AXIS, None), tp))(out)
                 ready = self.has_variable("cache", "cached_key")
                 store = jnp.int8 if self.kv_quant else self.dtype
                 ck = self.variable(
@@ -340,6 +376,98 @@ class CausalSelfAttention(nn.Module):
             kernel_init=_part(nn.initializers.lecun_normal(),
                               (meshlib.MODEL_AXIS, None), tp))(out)
 
+    def _paged_attend(self, x, q, k, v, pos, block_tables, widen,
+                      kvh, head_dim):
+        """Paged KV write + read (decode_slots + paged_blocks > 0).
+
+        Cache variables are the shared physical pools; per-slot state is
+        the caller's block table.  Writes scatter each (row, position)
+        K/V vector into ``pool[bt[row, pos // blk], pos % blk]``; reads
+        go fused (Pallas kernel) or unfused (gather + dense — bitwise
+        the monolithic token-block branch's math over the gathered
+        table, which is what keeps paged prefill exactly equal to
+        monolithic prefill)."""
+        b = x.shape[0]
+        blk = self.paged_block
+        if self.max_len % blk:
+            raise ValueError(
+                f"paged_block={blk} must divide max_len={self.max_len}")
+        ready = self.has_variable("cache", "key_pool")
+        store = jnp.int8 if self.kv_quant else self.dtype
+        kp = self.variable(
+            "cache", "key_pool", jnp.zeros,
+            (self.paged_blocks, blk, kvh, head_dim), store)
+        vp = self.variable(
+            "cache", "value_pool", jnp.zeros,
+            (self.paged_blocks, blk, kvh, head_dim), store)
+        if self.kv_quant:
+            ksp = self.variable(
+                "cache", "key_scale_pool", jnp.zeros,
+                (self.paged_blocks, blk, kvh), jnp.float32)
+            vsp = self.variable(
+                "cache", "value_scale_pool", jnp.zeros,
+                (self.paged_blocks, blk, kvh), jnp.float32)
+        if not ready:
+            # .init(): create the pools, write nothing (the same
+            # init-time guard as the monolithic cache)
+            return dense_attention(q, widen(k), widen(v), causal=True)
+        if block_tables is None:
+            raise ValueError(
+                "paged decode needs block_tables (B, max_blocks) — the "
+                "serving engine passes each slot's block table")
+        idx = pos                                    # (B, L)
+        # positions past max_len (pad rows of a chunk-scan bucket) must
+        # DROP like the monolithic scatter does — but gather CLAMPS, so
+        # an unclamped table lookup would alias the slot's own last
+        # block.  Route oob positions to an oob OFFSET instead: the
+        # block-id gather is clamped harmlessly and the scatter's
+        # default drop rule discards the write.
+        j = idx // blk
+        oob = j >= block_tables.shape[1]
+        blk_ids = jnp.take_along_axis(
+            block_tables, jnp.minimum(j, block_tables.shape[1] - 1), axis=1)
+        off = jnp.where(oob, blk, idx % blk)
+        if self.kv_quant:
+            qk, sk = compression.int8_channel_encode(k)
+            qv, sv = compression.int8_channel_encode(v)
+            kp.value = kp.value.at[blk_ids, off].set(qk)
+            vp.value = vp.value.at[blk_ids, off].set(qv)
+            ksp.value = ksp.value.at[blk_ids, off].set(sk)
+            vsp.value = vsp.value.at[blk_ids, off].set(sv)
+        else:
+            kp.value = kp.value.at[blk_ids, off].set(
+                k.astype(kp.value.dtype))
+            vp.value = vp.value.at[blk_ids, off].set(
+                v.astype(vp.value.dtype))
+        if self.paged_fused:
+            from distributed_tensorflow_tpu.ops.paged_attention import (
+                paged_attention)
+            return paged_attention(
+                q, kp.value, vp.value, block_tables, idx[:, 0],
+                k_scale=ksp.value if self.kv_quant else None,
+                v_scale=vsp.value if self.kv_quant else None,
+            ).astype(self.dtype)
+        # unfused: gather the logical table back through the block table
+        # and run the SAME masked dense attention as the monolithic
+        # token-block branch — garbage rows from unmapped entries sit
+        # past the validity mask
+        t = self.max_len
+        keys = jnp.take(kp.value, block_tables, axis=0).reshape(
+            b, t, kvh, head_dim)
+        vals = jnp.take(vp.value, block_tables, axis=0).reshape(
+            b, t, kvh, head_dim)
+        if self.kv_quant:
+            kscale = jnp.take(ksp.value, block_tables, axis=0).reshape(
+                b, t, kvh)
+            vscale = jnp.take(vsp.value, block_tables, axis=0).reshape(
+                b, t, kvh)
+            keys = compression.int8_channel_decode(keys, kscale, self.dtype)
+            vals = compression.int8_channel_decode(vals, vscale, self.dtype)
+        valid = (jnp.arange(t)[None, None, :]
+                 <= idx[:, :, None]).astype(self.dtype)
+        return dense_attention(q, widen(keys), widen(vals),
+                               causal=False, kv_mask=valid)
+
 
 class GPTBlock(nn.Module):
     """Pre-LN decoder block: x + attn(LN(x)); x + ffn(LN(x)).
@@ -370,16 +498,23 @@ class GPTBlock(nn.Module):
     partition_experts: bool = False
     decode_slots: bool = False   # serving slot-table decode (see attention)
     kv_quant: bool = False       # int8 KV storage (see attention)
+    paged_blocks: int = 0        # paged KV pool size (see attention)
+    paged_block: int = 16        # tokens per physical block
+    paged_fused: bool = False    # fused Pallas paged read (see attention)
 
     @nn.compact
-    def __call__(self, x, train: bool = False, pos=None):
+    def __call__(self, x, train: bool = False, pos=None, block_tables=None):
         tp = self.partition_model
         y = CausalSelfAttention(self.hidden, self.heads, self.attention_impl,
                                 self.seq_axis, tp, self.decode, self.max_len,
                                 self.rope, self.kv_heads, self.dtype,
                                 decode_slots=self.decode_slots,
-                                kv_quant=self.kv_quant)(
-                                    nn.LayerNorm(dtype=self.dtype)(x), pos)
+                                kv_quant=self.kv_quant,
+                                paged_blocks=self.paged_blocks,
+                                paged_block=self.paged_block,
+                                paged_fused=self.paged_fused)(
+                                    nn.LayerNorm(dtype=self.dtype)(x), pos,
+                                    block_tables)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
@@ -463,11 +598,21 @@ class GPTLM(nn.Module):
                                  # scales (decode_slots only; --serve-kv-
                                  # dtype int8 — the stored table is ~¼ of
                                  # f32, ~½ of bf16)
+    paged_blocks: int = 0        # >0: paged KV layout (decode_slots only;
+                                 # --serve-kv-layout paged) — one shared
+                                 # physical block pool + caller-owned
+                                 # per-slot block tables instead of
+                                 # (slots, max_len) rows
+    paged_block: int = 16        # tokens per physical block (divides
+                                 # max_len)
+    paged_fused: bool = False    # fused Pallas paged-attention read
+                                 # (ops/paged_attention.py)
 
     causal_lm = True  # read by engines/harness to select the LM data layout
 
     @nn.compact
-    def __call__(self, token_ids, train: bool = False, positions=None):
+    def __call__(self, token_ids, train: bool = False, positions=None,
+                 block_tables=None):
         seq_parallel = self.attention_impl in ("ring", "ring_flash",
                                                "ulysses", "ulysses_flash")
         lq = token_ids.shape[1]
@@ -478,6 +623,15 @@ class GPTLM(nn.Module):
             raise ValueError(
                 "positions is only accepted in decode_slots mode — every "
                 "other mode derives positions internally (cursor/offset)")
+        if self.paged_blocks and not self.decode_slots:
+            raise ValueError(
+                "paged_blocks > 0 is a serving storage layout: it "
+                "requires decode_slots=True (the serving engine owns the "
+                "block tables)")
+        if block_tables is not None and not self.paged_blocks:
+            raise ValueError(
+                "block_tables is only accepted in paged decode_slots "
+                "mode (paged_blocks > 0)")
         if self.decode:
             if seq_parallel:
                 # the hard constraint: ring/ulysses run inside shard_map
@@ -575,9 +729,13 @@ class GPTLM(nn.Module):
                           self.moe_capacity_factor, self.partition_experts,
                           decode_slots=self.decode_slots,
                           kv_quant=self.kv_quant,
+                          paged_blocks=self.paged_blocks,
+                          paged_block=self.paged_block,
+                          paged_fused=self.paged_fused,
                           name=f"GPTBlock_{i}")(
                               x, train,
-                              pos if (rope or self.decode_slots) else None)
+                              pos if (rope or self.decode_slots) else None,
+                              block_tables)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.tie_embeddings:
             # tied head: contraction against the (possibly vocab-sharded)

@@ -546,6 +546,14 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     # regression).  Both lower-is-better.
     ("serve_failover_recovery_p95_s", "lower"),
     ("serve_duplicate_emissions", "lower"),
+    # paged KV (round 16; BASELINE.md "Paged accounting"): blocks in use
+    # at equal workload is the footprint the block pool exists to shrink
+    # (aliased prefixes stored once), and the zero-copy hit rate is the
+    # fraction of prefix-pool lookups served by pointer aliasing instead
+    # of device copies — fewer zero-copy hits at the same trace means
+    # admissions are paying prefill for KV the pool already holds.
+    ("serve_kv_blocks_in_use", "lower"),
+    ("serve_prefix_zero_copy_hit_rate", "higher"),
 )
 
 

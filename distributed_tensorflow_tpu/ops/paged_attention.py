@@ -1,0 +1,263 @@
+"""Paged decode attention as a Pallas TPU kernel (vLLM's PagedAttention).
+
+The serving KV table in ``serving/kv_cache.py`` historically stored one
+contiguous ``(slots, max_len)`` row per slot, and the decode step ran a
+full-width gather + softmax over it.  The paged layout (Kwon et al.,
+arXiv:2309.06180) breaks that row into fixed-size physical blocks in one
+shared pool ``(num_blocks, block, kv_heads, head_dim)`` and gives each slot
+an int32 *block table*; a prefix-cache hit then aliases pool blocks by
+pointer instead of copying KV bytes.  This kernel is the read side of that
+design: a decode/verify attention kernel that follows the block table
+**inside** the kernel, so the gathered ``(slots, max_len)`` K/V copy never
+materializes in HBM.
+
+Grid ``(slots, kv_heads, max_blocks)`` — the block axis iterates innermost
+and sequentially, which is what lets the online-softmax accumulators
+(m/l/acc) persist in VMEM scratch across a slot's blocks (the same pattern
+as ``_fwd_kernel`` in flash_attention.py).  The block table and per-slot
+positions ride in as *scalar-prefetch* operands
+(``pltpu.PrefetchScalarGridSpec``): each K/V BlockSpec's index_map reads
+``bt[s, j]`` to window the pool block-indirectly, the Pallas analogue of
+vLLM's physical-block lookup.
+
+Queries are ``(slots, l_q, heads, head_dim)`` — ``l_q == 1`` is the decode
+step and ``l_q == k+1`` the speculative ``verify_block`` variant; each
+query row is masked to keys at or before its own position
+(``t <= pos + row % l_q``).  Grouped-query attention folds the query-head
+group into the row axis, so the kernel always sees one kv head per grid
+step.  int8 KV composes in-kernel: the quantized pool blocks are
+dequantized from their per-vector scale blocks right after the windowed
+load — the materialized f32 table the unfused path pays for never exists.
+
+On non-TPU backends the kernel runs in Pallas interpret mode (the
+flash_attention precedent), so CPU CI exercises the real kernel, not a
+shadow implementation.  ``paged_attention_reference`` is the pure-jnp twin:
+the gather + dense-softmax oracle used for parity tests and as the
+fallback when operands carry varying axes under ``jax.shard_map`` on CPU
+(interpret mode cannot lower pallas_call under vma checking).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific bits are unavailable in some CPU-only wheels
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+NEG_INF = -1e30  # matches parallel.ring_attention.NEG_INF
+_TINY = 1e-30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _join_vma(*xs) -> frozenset:
+    """Union of the operands' varying-axes sets (shard_map check_vma).
+    jax wheels before ``jax.typeof`` have no vma concept — empty set."""
+    typeof = getattr(jax, "typeof", None)
+    vma = frozenset()
+    if typeof is None:
+        return vma
+    for x in xs:
+        if x is not None:
+            vma |= typeof(x).vma
+    return vma
+
+
+def _kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+            nb, blk, l_q, sm_scale, quantized):
+    """One (slot, kv_head, block) grid step of the online softmax.
+
+    ``q_ref`` block is (1, 1, GL, D) — GL = group × l_q query rows for this
+    kv head; ``k_ref``/``v_ref`` blocks are (1, blk, 1, D) pool blocks
+    windowed through ``bt_ref[s, j]``.  When ``quantized``, ``rest`` leads
+    with the (1, blk, 1) per-vector scale blocks.
+    """
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
+    s, j = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    p0 = pos_ref[s]  # first query's position for this slot
+
+    def compute():
+        qb = q_ref[0, 0].astype(jnp.float32)          # (GL, D)
+        kb = k_ref[0][:, 0].astype(jnp.float32)       # (blk, D)
+        vb = v_ref[0][:, 0].astype(jnp.float32)
+        if quantized:  # in-kernel dequant from the per-vector scales
+            kb = kb * ks_ref[0][:, 0][:, None]
+            vb = vb * vs_ref[0][:, 0][:, None]
+        sc = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        sc = sc * sm_scale
+        # key position t vs each query row's own position (row % l_q walks
+        # the verify block; the group axis repeats the same position)
+        t = j * blk + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+        qoff = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 0) % l_q
+        sc = jnp.where(t <= p0 + qoff, sc, NEG_INF)
+
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_new = jnp.maximum(m_prev, sc.max(axis=-1, keepdims=True))
+        p = jnp.exp(sc - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_prev * corr + p.sum(axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
+            p, vb, preferred_element_type=jnp.float32)
+
+    # skip blocks entirely past the last query's position (dead keys)
+    pl.when(j * blk <= p0 + l_q - 1)(compute)
+
+    @pl.when(j == nb - 1)
+    def _():
+        o_ref[0, 0] = (acc_scr[:]
+                       / jnp.maximum(l_scr[:], _TINY)).astype(o_ref.dtype)
+
+
+def _fold_gqa(q, kv_heads):
+    """(S, L, H, D) → (S, KVH, G·L, D): group rides the query-row axis."""
+    s, l, h, d = q.shape
+    g = h // kv_heads
+    return (q.reshape(s, l, kv_heads, g, d)
+            .transpose(0, 2, 3, 1, 4).reshape(s, kv_heads, g * l, d))
+
+
+def _unfold_gqa(out, l_q, heads):
+    s, kvh, gl, d = out.shape
+    g = gl // l_q
+    return (out.reshape(s, kvh, g, l_q, d)
+            .transpose(0, 3, 1, 2, 4).reshape(s, l_q, heads, d))
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, positions, *,
+                              k_scale=None, v_scale=None, scale=None):
+    """Pure-jnp oracle: gather the pool through the block table, dequant,
+    widen kv heads, dense masked softmax.  Same signature as the kernel
+    entry; the parity tests pin the kernel against this."""
+    s, l_q, h, d = q.shape
+    n, blk, kvh, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    keys = jnp.take(k_pool, block_tables, axis=0).reshape(s, mb * blk, kvh, d)
+    vals = jnp.take(v_pool, block_tables, axis=0).reshape(s, mb * blk, kvh, d)
+    if k_scale is not None:
+        ks = jnp.take(k_scale, block_tables, axis=0).reshape(s, mb * blk, kvh)
+        vs = jnp.take(v_scale, block_tables, axis=0).reshape(s, mb * blk, kvh)
+        keys = keys.astype(jnp.float32) * ks[..., None]
+        vals = vals.astype(jnp.float32) * vs[..., None]
+    if kvh != h:
+        keys = jnp.repeat(keys, h // kvh, axis=2)
+        vals = jnp.repeat(vals, h // kvh, axis=2)
+    from distributed_tensorflow_tpu.parallel.ring_attention import (
+        dense_attention)
+    t = jnp.arange(mb * blk, dtype=jnp.int32)
+    valid = (t[None, None, :]
+             <= positions[:, None, None]
+             + jnp.arange(l_q, dtype=jnp.int32)[None, :, None])
+    out = dense_attention(q.astype(jnp.float32), keys.astype(jnp.float32),
+                          vals.astype(jnp.float32), causal=False,
+                          scale=scale, kv_mask=valid)
+    return out.astype(q.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, positions, *,
+                    k_scale=None, v_scale=None, scale=None,
+                    interpret=None):
+    """Fused paged decode attention.
+
+    Args:
+      q: (slots, l_q, heads, head_dim) queries — model layout; ``l_q`` is 1
+        for the decode step, ``k+1`` for speculative verify.
+      k_pool, v_pool: (num_blocks, block, kv_heads, head_dim) physical
+        block pools (f32/bf16, or int8 with scales).
+      block_tables: (slots, max_blocks) int32 — pool block id per logical
+        block.  Unmapped entries must hold a valid index (0 is fine): the
+        length mask kills their scores, but the windowed load still reads.
+      positions: (slots,) int32 — position of each slot's FIRST query row
+        (its current length); query row r attends keys ``t <= pos + r``.
+      k_scale, v_scale: (num_blocks, block, kv_heads) f32 per-vector
+        scales, required iff the pools are int8 (in-kernel dequant).
+      scale: softmax scale; defaults to ``head_dim ** -0.5``.
+      interpret: Pallas interpret mode; defaults to True off-TPU.
+
+    Returns (slots, l_q, heads, head_dim) in ``q.dtype``.
+    """
+    s, l_q, h, d = q.shape
+    n, blk, kvh, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if h % kvh:
+        raise ValueError(f"heads={h} not divisible by kv_heads={kvh}")
+    if interpret is None:
+        interpret = _interpret_default()
+    if interpret and _join_vma(q, k_pool, v_pool, k_scale, v_scale):
+        # shard_map-on-CPU: interpret mode cannot lower under vma
+        # checking — fall back to the jnp twin (flash_attention precedent)
+        return paged_attention_reference(
+            q, k_pool, v_pool, block_tables, positions,
+            k_scale=k_scale, v_scale=v_scale, scale=scale)
+    sm_scale = scale if scale is not None else d ** -0.5
+    gl = (h // kvh) * l_q
+    qf = _fold_gqa(q, kvh)
+    bt = block_tables.astype(jnp.int32)
+    pos = positions.astype(jnp.int32)
+
+    kernel = functools.partial(_kernel, nb=mb, blk=blk, l_q=l_q,
+                               sm_scale=sm_scale, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, 1, gl, d), lambda s, h, j, bt, pos: (s, h, 0, 0)),
+        pl.BlockSpec((1, blk, 1, d),
+                     lambda s, h, j, bt, pos: (bt[s, j], 0, h, 0)),
+        pl.BlockSpec((1, blk, 1, d),
+                     lambda s, h, j, bt, pos: (bt[s, j], 0, h, 0)),
+    ]
+    operands = [qf, k_pool, v_pool]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, blk, 1),
+                         lambda s, h, j, bt, pos: (bt[s, j], 0, h)),
+            pl.BlockSpec((1, blk, 1),
+                         lambda s, h, j, bt, pos: (bt[s, j], 0, h)),
+        ]
+        operands += [k_scale, v_scale]
+    if pltpu is None:  # pragma: no cover - CPU wheels without pallas.tpu
+        raise NotImplementedError(
+            "paged_attention needs jax.experimental.pallas.tpu "
+            "(PrefetchScalarGridSpec) — unavailable in this wheel")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, kvh, mb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, gl, d),
+                               lambda s, h, j, bt, pos: (s, h, 0, 0)),
+        scratch_shapes=[
+            _VMEM((gl, 1), jnp.float32),
+            _VMEM((gl, 1), jnp.float32),
+            _VMEM((gl, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, kvh, gl, d), q.dtype),
+        interpret=interpret,
+    )(bt, pos, *operands)
+    return _unfold_gqa(out, l_q, h)

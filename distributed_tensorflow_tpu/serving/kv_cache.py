@@ -91,6 +91,14 @@ class SlotOverflow(RuntimeError):
     ADVICE r3: never silently clamp)."""
 
 
+class BlockPoolExhausted(RuntimeError):
+    """The paged KV block pool has no free physical block for a required
+    write.  Admission-level pool pressure is the SCHEDULER's problem
+    (``PagedSlotKVCache.can_admit`` + block budgets defer admissions);
+    reaching this mid-flight means the block accounting is broken — the
+    paged twin of ``SlotOverflow``, not an overload signal."""
+
+
 class SlotKVCache:
     """Fixed slot table + compiled prefill/decode programs for one GPTLM.
 
@@ -107,10 +115,30 @@ class SlotKVCache:
     through the two compiled programs.
     """
 
+    def __new__(cls, *args, kv_layout: str = "monolithic", **kwargs):
+        # --serve-kv-layout dispatch: constructing a SlotKVCache with
+        # kv_layout="paged" yields the paged subclass, so every call site
+        # (harness, bench, fleet's build_replica_kvs **kv_kwargs
+        # pass-through) selects the layout with one kwarg and no factory
+        if cls is SlotKVCache and kv_layout == "paged":
+            return super().__new__(PagedSlotKVCache)
+        return super().__new__(cls)
+
     def __init__(self, model: GPTLM, params, slots: int, *,
                  mesh=None, greedy: bool = True, temperature: float = 1.0,
                  prefill_bucket: int = 8, rng=None, kv_dtype=None,
-                 prefix_cache_blocks: int = 0, prefix_block: int = 16):
+                 prefix_cache_blocks: int = 0, prefix_block: int = 16,
+                 kv_layout: str = "monolithic", paged_blocks: int = 0,
+                 paged_block: int = 0, paged_fused: bool = True):
+        if kv_layout not in ("monolithic", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'monolithic' or 'paged', "
+                f"got {kv_layout!r}")
+        if paged_blocks or paged_block:
+            raise ValueError(
+                "paged_blocks/paged_block only apply to "
+                "kv_layout='paged'")
+        self.kv_layout = "monolithic"
         if slots < 1:
             raise ValueError(f"slots must be positive, got {slots}")
         if prefix_cache_blocks < 0:
@@ -855,3 +883,593 @@ class SlotKVCache:
                 "prefill_chunk_buckets": len(self._chunks),
                 "prefix_block_ops": (0 if self._read_block is None else 2),
                 "verify_widths": len(self._verifies)}
+
+
+class PagedSlotKVCache(SlotKVCache):
+    """Paged KV layout (vLLM PagedAttention, arXiv:2309.06180): one
+    physical block pool shared by every slot + host-owned per-slot block
+    tables, selected by ``SlotKVCache(..., kv_layout="paged")``.
+
+    What changes vs the monolithic table:
+
+    * DEVICE: cache leaves are pools ``(num_blocks+1, block, kv_heads,
+      head_dim)`` (+1 is a scratch block — see below) instead of
+      ``(slots, max_len, ...)`` rows; the model's paged decode mode
+      (models/gpt.py ``paged_blocks``) scatters each write through the
+      block table and reads either fused (ops/paged_attention.py Pallas
+      kernel — the decode/verify hot op) or by gather + dense (bitwise
+      the monolithic math — the prefill scan).
+    * HOST: block allocation, refcounts, and the block tables.  A
+      prefix-pool hit is a POINTER WRITE — matched pool blocks are
+      aliased into the slot's table with a refcount bump and zero KV
+      bytes copied (counted in ``paged_stats``); the pool itself stores
+      block IDS with a refcount pin, so each hot prefix exists exactly
+      once in device memory.  The first write into a shared block
+      triggers copy-on-write: one jitted block copy into a freshly
+      allocated block, after which the writer owns its copy and the
+      other sharers (and the pool) are untouched.
+    * SAFETY: the pool carries one extra SCRATCH block (id
+      ``num_blocks``); unmapped table entries point at it, and during
+      decode/verify the device sees scratch-only table rows for
+      non-participating slots — the monolithic layout's "garbage writes
+      land in your own row" argument becomes "garbage writes land in
+      scratch".  Out-of-range positions are dropped by construction
+      (models/gpt.py routes them to an out-of-bounds offset, the scatter
+      drop rule).
+    * CAPACITY: ``kv_bytes_per_slot`` reports bytes actually backing
+      live sequences — in-use pool blocks (payload + scales) + block
+      tables, amortized over live slots (the BASELINE stored-bytes
+      rule) — not ``slots × max_len``.  Admission is gated by
+      ``can_admit`` (free blocks vs the request's worst-case block need
+      plus committed-but-unallocated budgets of live slots); running the
+      pool dry mid-flight raises ``BlockPoolExhausted``.
+
+    Parity contract: prefill (gather path) is bitwise the monolithic
+    prefill; fused decode/verify is tolerance-based (online-softmax
+    reassociation — the int8 precedent).  ``paged_fused=False`` keeps
+    even decode on the gather path (the parity oracle in paged clothes).
+    """
+
+    def __init__(self, model: GPTLM, params, slots: int, *,
+                 mesh=None, greedy: bool = True, temperature: float = 1.0,
+                 prefill_bucket: int = 8, rng=None, kv_dtype=None,
+                 prefix_cache_blocks: int = 0, prefix_block: int = 16,
+                 kv_layout: str = "paged", paged_blocks: int = 0,
+                 paged_block: int = 0, paged_fused: bool = True):
+        if kv_layout != "paged":
+            raise ValueError("PagedSlotKVCache is the kv_layout='paged' "
+                             "implementation")
+        if slots < 1:
+            raise ValueError(f"slots must be positive, got {slots}")
+        if prefix_cache_blocks < 0:
+            raise ValueError(f"prefix_cache_blocks must be >= 0, got "
+                             f"{prefix_cache_blocks}")
+        if prefix_block < 1:
+            raise ValueError(f"prefix_block must be positive, got "
+                             f"{prefix_block}")
+        self.kv_layout = "paged"
+        self.slots = int(slots)
+        self.max_len = int(model.max_len)
+        self.greedy = bool(greedy)
+        self.temperature = float(temperature)
+        self.prefill_bucket = int(prefill_bucket)
+        self.mesh = mesh
+        # ONE block granularity: aliasing a pooled prefix block into a
+        # slot's table only works when the prefix pool and the physical
+        # pool agree on the block size
+        block = int(paged_block) if paged_block else int(prefix_block)
+        if prefix_cache_blocks and paged_block \
+                and int(paged_block) != int(prefix_block):
+            raise ValueError(
+                f"paged_block ({paged_block}) must equal prefix_block "
+                f"({prefix_block}) when the prefix pool is on: pool hits "
+                f"alias physical blocks by pointer")
+        if block < 1:
+            raise ValueError(f"paged_block must be positive, got {block}")
+        if self.max_len % block:
+            raise ValueError(
+                f"paged_block={block} must divide max_len={self.max_len}")
+        self.paged_block = block
+        self.prefix_block = block
+        self.max_blocks = self.max_len // block          # table width
+        # default pool: every slot can grow to max_len (+1 block CoW
+        # headroom per slot when aliasing is possible) and the prefix
+        # pool can pin its full capacity — sized so the default NEVER
+        # exhausts; smaller explicit pools rely on can_admit deferral
+        cow_pad = 1 if prefix_cache_blocks else 0
+        self.num_blocks = int(paged_blocks) if paged_blocks else (
+            self.slots * (self.max_blocks + cow_pad)
+            + int(prefix_cache_blocks))
+        if self.num_blocks < self.max_blocks + cow_pad:
+            raise ValueError(
+                f"paged_blocks={self.num_blocks} cannot hold even one "
+                f"full slot ({self.max_blocks} blocks + {cow_pad} CoW "
+                f"headroom)")
+        self._scratch = self.num_blocks  # physical id of the scratch block
+
+        self.quantized = False
+        if kv_dtype is not None:
+            kv_dtype = jnp.dtype(kv_dtype)
+            self.quantized = kv_dtype == jnp.dtype(jnp.int8)
+        keep_tp = (mesh is not None and model.partition_model
+                   and meshlib.MODEL_AXIS in mesh.axis_names)
+        # fused clone for the decode/verify hot ops, gather clone for the
+        # prefill scan (bitwise-monolithic math) — same params, same
+        # cache variables, only the read path differs
+        self.paged_fused = bool(paged_fused)
+        self.dm = model.clone(decode=True, decode_slots=True,
+                              attention_impl="dense",
+                              partition_model=keep_tp, dropout_rate=0.0,
+                              kv_quant=self.quantized,
+                              paged_blocks=self.num_blocks + 1,
+                              paged_block=block,
+                              paged_fused=self.paged_fused)
+        self.dm_gather = self.dm.clone(paged_fused=False)
+        self._rng = rng if rng is not None else jax.random.key(0)
+
+        dummy = jnp.zeros((self.slots, 1), jnp.int32)
+        shapes = jax.eval_shape(
+            lambda: self.dm.init(jax.random.key(0), dummy, train=False,
+                                 positions=dummy))["cache"]
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        if kv_dtype is not None and not self.quantized:
+            cache = jax.tree.map(
+                lambda t: t.astype(kv_dtype)
+                if jnp.issubdtype(t.dtype, jnp.floating) else t, cache)
+        self.kv_dtype = "int8" if self.quantized else next(
+            (str(leaf.dtype) for leaf in jax.tree.leaves(cache)
+             if jnp.issubdtype(leaf.dtype, jnp.floating)), "float32")
+
+        self._vec_sharding = None
+        self._blk_sharding = None
+        if mesh is not None:
+            dp = mesh.shape.get(meshlib.DATA_AXIS, 1)
+            if self.slots % dp:
+                raise ValueError(
+                    f"slots ({self.slots}) must divide by the mesh's data "
+                    f"axis ({dp}): each data shard owns a contiguous slot "
+                    f"block")
+            # pool leaves REPLICATE: any slot (sharded over 'data') may
+            # read/write any physical block, so a block-dim sharding
+            # would turn every table-indirect access into a reshard
+            repl = NamedSharding(mesh, P())
+            cache = jax.tree.map(lambda t: jax.device_put(t, repl), cache)
+            self._vec_sharding = meshlib.kv_slot_sharding(mesh, 1)
+            self._blk_sharding = meshlib.kv_slot_sharding(mesh, 2)
+        self.cache = cache
+        self.params = self._place_params(params)
+
+        # host slot table (identical to monolithic) ...
+        self.lengths = np.zeros(self.slots, np.int32)
+        self.active = np.zeros(self.slots, np.bool_)
+        self.reserved = np.zeros(self.slots, np.bool_)
+        self.tokens = np.zeros(self.slots, np.int32)
+        self._pending: dict[int, dict] = {}
+
+        # ... plus the paged substrate: refcounted physical blocks, a
+        # free list, per-slot logical→physical tables (host numpy; the
+        # device sees a masked snapshot per program call)
+        self._block_refs = np.zeros(self.num_blocks, np.int32)
+        self._free_list = list(range(self.num_blocks))[::-1]  # pop() → 0,1,..
+        self._slot_blocks: list[list[int]] = [[] for _ in range(self.slots)]
+        self.block_tables_np = np.full(
+            (self.slots, self.max_blocks), self._scratch, np.int32)
+        # committed block budgets (can_admit's outstanding ledger):
+        # worst-case blocks each live admission may still allocate
+        self._slot_need = np.zeros(self.slots, np.int32)
+        self._paged_counters = {"zero_copy_hits": 0, "zero_copy_blocks": 0,
+                                "zero_copy_tokens": 0, "cow_copies": 0}
+
+        # prefix pool: key → PHYSICAL BLOCK ID with a refcount pin (the
+        # monolithic pool stores device byte copies; here the pool IS
+        # the aliasing table — zero bytes stored twice)
+        self.prefix_cache_blocks = int(prefix_cache_blocks)
+        self._prefix_pool: OrderedDict[bytes, int] = OrderedDict()
+        self.prefix_stats = {"hits": 0, "misses": 0, "evictions": 0,
+                             "tokens_reused": 0, "inserted_blocks": 0}
+
+        self.prefill_tokens_computed = 0
+        self._phase_s = {"prefill_s": 0.0, "decode_s": 0.0}
+
+        self._step = self._build_step()
+        self._prefills: dict[int, object] = {}   # unused: paged admission
+        self._chunks: dict[int, object] = {}     # always chunks
+        self._verifies: dict[int, object] = {}
+        self._read_block = None                  # monolithic pool programs
+        self._write_block = None                 # never built under paged
+        self._copy_block = None                  # CoW block copy (lazy)
+
+    # -------------------------------------------------- block bookkeeping
+    @property
+    def blocks_in_use(self) -> int:
+        """Allocated physical blocks (scratch excluded)."""
+        return self.num_blocks - len(self._free_list)
+
+    def _alloc_block(self) -> int:
+        if not self._free_list:
+            raise BlockPoolExhausted(
+                f"paged KV pool exhausted: all {self.num_blocks} blocks "
+                f"in use — the scheduler's can_admit gate should have "
+                f"deferred this admission (block budget accounting bug, "
+                f"or the pool was sized below slots × max_len/block)")
+        bid = self._free_list.pop()
+        self._block_refs[bid] = 1
+        return bid
+
+    def _release_block(self, bid: int) -> None:
+        self._block_refs[bid] -= 1
+        if self._block_refs[bid] == 0:
+            self._free_list.append(bid)
+
+    def _release_slot_blocks(self, slot: int) -> None:
+        for bid in self._slot_blocks[slot]:
+            self._release_block(bid)
+        self._slot_blocks[slot].clear()
+        self.block_tables_np[slot, :] = self._scratch
+        self._slot_need[slot] = 0
+
+    def _build_copy(self):
+        def copy(cache, src, dst):
+            return jax.tree.map(
+                lambda t: lax.dynamic_update_slice(
+                    t, lax.dynamic_slice(
+                        t, (src,) + (0,) * (t.ndim - 1),
+                        (1,) + t.shape[1:]),
+                    (dst,) + (0,) * (t.ndim - 1)), cache)
+
+        return jax.jit(copy, donate_argnums=0)
+
+    def _ensure_writable(self, slot: int, start: int, end: int) -> None:
+        """Make positions ``[start, end)`` of ``slot`` safely writable:
+        allocate missing blocks, copy-on-write shared ones.  A shared
+        block (refcount > 1 — aliased from the prefix pool or pinned BY
+        it) gets one jitted block copy into a fresh allocation; the
+        slot's table then points at its private copy and every other
+        sharer keeps reading the original."""
+        if end <= start:
+            return
+        sb = self._slot_blocks[slot]
+        blk = self.paged_block
+        last = min((end - 1) // blk, self.max_blocks - 1)
+        for j in range(start // blk, last + 1):
+            while len(sb) <= j:      # extend coverage with fresh blocks
+                bid = self._alloc_block()
+                sb.append(bid)
+                self.block_tables_np[slot, len(sb) - 1] = bid
+            bid = sb[j]
+            if self._block_refs[bid] > 1:   # shared → copy-on-write
+                if self._copy_block is None:
+                    self._copy_block = self._build_copy()
+                new = self._alloc_block()
+                self.cache = self._copy_block(
+                    self.cache, jnp.int32(bid), jnp.int32(new))
+                self._release_block(bid)
+                sb[j] = new
+                self.block_tables_np[slot, j] = new
+                self._paged_counters["cow_copies"] += 1
+
+    def _masked_bt(self, mask):
+        """Device block-table snapshot with non-participating rows routed
+        wholly to scratch — their garbage scatter writes can never land
+        in a live (possibly shared) block."""
+        bt = np.where(np.asarray(mask, np.bool_)[:, None],
+                      self.block_tables_np, np.int32(self._scratch))
+        return self._put_repl(bt.astype(np.int32))
+
+    # ------------------------------------------------- admission budgets
+    def _block_need(self, total_len: int) -> int:
+        need = -(-int(total_len) // self.paged_block)
+        if self.prefix_cache_blocks:
+            need += 1   # CoW headroom: a fully-aligned prefix hit
+                        # recomputes its last token INTO a shared block
+        return min(need, self.max_blocks + (1 if self.prefix_cache_blocks
+                                            else 0))
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Block-exhaustion admission gate: free blocks (minus what live
+        admissions may still claim under their registered budgets) must
+        cover this request's worst-case need.  Conservative — aliasing
+        only helps (aliased blocks never touch the free list)."""
+        outstanding = sum(
+            max(int(self._slot_need[s]) - len(self._slot_blocks[s]), 0)
+            for s in range(self.slots) if self._slot_need[s])
+        need = self._block_need(int(prompt_len) + int(max_new_tokens))
+        return len(self._free_list) - outstanding >= need
+
+    def note_admission(self, slot: int, total_len: int) -> None:
+        """Register an admitted request's worst-case block budget (the
+        scheduler calls this with prompt + max_new_tokens); cleared on
+        evict/abort."""
+        self._slot_need[slot] = self._block_need(total_len)
+
+    # ------------------------------------------------------------ programs
+    def _build_step(self):
+        dm = self.dm
+
+        def step(params, cache, tokens, lengths, active, bt, rng):
+            logits, upd = dm.apply(
+                {"params": params, "cache": cache}, tokens[:, None],
+                train=False, positions=lengths[:, None],
+                block_tables=bt, mutable=["cache"])
+            nxt = self._sample(logits[:, -1], rng).astype(tokens.dtype)
+            return upd["cache"], jnp.where(active, nxt, tokens)
+
+        return jax.jit(step, donate_argnums=1)
+
+    def _chunk(self, lpad: int):
+        """Chunk-resumable prefill over the FULL pool (there is no
+        per-slot cache slice to extract — the slot's identity is its
+        block table row): same scan/positions/sampling contract as the
+        monolithic ``_chunk``, gather read path (bitwise-monolithic
+        math over the gathered table)."""
+        dm = self.dm_gather
+
+        def chunk(params, cache, bt_row, tokens, start, n_valid, rng):
+            def body(c, xs):
+                tok, t = xs
+                logits, upd = dm.apply(
+                    {"params": params, "cache": c}, tok[None, None],
+                    train=False, positions=t[None, None],
+                    block_tables=bt_row, mutable=["cache"])
+                return upd["cache"], logits[0, -1]
+
+            cache, all_logits = lax.scan(
+                body, cache,
+                (tokens, start + jnp.arange(lpad, dtype=jnp.int32)))
+            last = jnp.take(all_logits, n_valid - 1, axis=0)
+            first = self._sample(last[None, :], rng)[0]
+            return cache, first.astype(tokens.dtype)
+
+        return jax.jit(chunk, donate_argnums=1)
+
+    def _verify(self, width: int):
+        dm = self.dm
+
+        def verify(params, cache, block, lengths, bt):
+            positions = (lengths[:, None]
+                         + jnp.arange(width, dtype=jnp.int32)[None, :])
+            logits, upd = dm.apply(
+                {"params": params, "cache": cache}, block,
+                train=False, positions=positions, block_tables=bt,
+                mutable=["cache"])
+            return upd["cache"], logits.argmax(-1).astype(block.dtype)
+
+        return jax.jit(verify, donate_argnums=1)
+
+    # ------------------------------------------------------------ slot API
+    def insert(self, prompt, slot: int | None = None) -> tuple[int, int]:
+        """Paged admission ALWAYS routes through the chunk-resumable
+        program (begin_insert + one uncapped chunk): there is no
+        slice-out monolithic prefill over a shared pool, and chunked
+        admission is the path whose writes go through
+        ``_ensure_writable`` (allocation + CoW)."""
+        slot, _ = self.begin_insert(prompt, slot)
+        try:
+            first = self.prefill_chunk(slot)
+        except BaseException:
+            if self.has_pending(slot):
+                self.abort_insert(slot)
+            elif self.active[slot]:
+                self.evict(slot)
+            raise
+        assert first is not None
+        return slot, first
+
+    def prefill_chunk(self, slot: int,
+                      max_tokens: int | None = None) -> int | None:
+        pend = self._pending.get(slot)
+        if pend is None:
+            raise RuntimeError(f"slot {slot} has no pending admission "
+                               f"(begin_insert first)")
+        filled, lp = pend["filled"], pend["lp"]
+        n = lp - filled
+        if max_tokens is not None:
+            if max_tokens < 1:
+                raise ValueError(
+                    f"max_tokens must be positive, got {max_tokens}")
+            n = min(n, int(max_tokens))
+        final = filled + n == lp
+        # allocation + CoW BEFORE the program runs: the scan's writes
+        # must only ever land in private (or scratch) blocks
+        self._ensure_writable(slot, filled, filled + n)
+        lpad = _bucket(n, 1, self.max_len)
+        padded = np.zeros(lpad, np.int32)
+        padded[:n] = pend["prompt"][filled:filled + n]
+        if lpad not in self._chunks:
+            self._chunks[lpad] = self._chunk(lpad)
+        bt_row = self._put_repl(
+            self.block_tables_np[slot:slot + 1].astype(np.int32))
+        t0 = time.perf_counter()
+        self.cache, first = self._chunks[lpad](
+            self.params, self.cache, bt_row,
+            self._put_repl(padded), jnp.int32(filled), jnp.int32(n),
+            self._next_rng())
+        self._phase_s["prefill_s"] += time.perf_counter() - t0
+        pend["filled"] = filled + n
+        self.lengths[slot] = filled + n
+        self.prefill_tokens_computed += n
+        if not final:
+            return None
+        first = int(first)
+        del self._pending[slot]
+        self.reserved[slot] = False
+        self.active[slot] = True
+        self.lengths[slot] = lp
+        self.tokens[slot] = first
+        self._pool_prefix(pend["prompt"], lp, slot)
+        return first
+
+    def abort_insert(self, slot: int) -> None:
+        super().abort_insert(slot)
+        self._release_slot_blocks(slot)
+
+    def evict(self, slot: int) -> None:
+        if not self.active[slot]:
+            raise RuntimeError(f"slot {slot} is not active")
+        self._release_slot_blocks(slot)
+        self.active[slot] = False
+        self.lengths[slot] = 0
+        self.tokens[slot] = 0
+
+    # ------------------------------------------------------- prefix pool
+    def _restore_prefix(self, prompt: np.ndarray, lp: int,
+                        slot: int) -> int:
+        """The zero-copy hit: matched pool blocks are aliased into the
+        slot's block table (pointer writes + refcount bumps) — no device
+        traffic at all.  Reuse covers FULL blocks including the one
+        holding the prompt's final token (unlike the monolithic
+        ``(lp-1)//blk`` cap): the final token is still recomputed (reuse
+        is capped at ``lp - 1`` positions), and its write into the
+        shared last block is what exercises copy-on-write."""
+        if not self.prefix_cache_blocks:
+            return 0
+        blk = self.prefix_block
+        usable = lp // blk
+        keys = self._prefix_keys(prompt, usable)
+        matched = 0
+        for key in keys:
+            if key not in self._prefix_pool:
+                break
+            matched += 1
+        reused = min(matched * blk, lp - 1)
+        self.prefix_stats["hits"] += matched
+        self.prefix_stats["misses"] += usable - matched
+        self.prefix_stats["tokens_reused"] += reused
+        if not matched:
+            return 0
+        sb = self._slot_blocks[slot]
+        for b, key in enumerate(keys[:matched]):
+            self._prefix_pool.move_to_end(key)   # LRU touch
+            bid = self._prefix_pool[key]
+            self._block_refs[bid] += 1
+            sb.append(bid)
+            self.block_tables_np[slot, b] = bid
+        self._paged_counters["zero_copy_hits"] += 1
+        self._paged_counters["zero_copy_blocks"] += matched
+        self._paged_counters["zero_copy_tokens"] += reused
+        return reused
+
+    def _pool_prefix(self, prompt: np.ndarray, lp: int, slot: int) -> None:
+        """Pool = pin: every full prompt block not already pooled gets a
+        refcount pin on the slot's OWN physical block (no extraction, no
+        copy — the pool and the slot share the block until eviction
+        drops the slot's reference)."""
+        if not self.prefix_cache_blocks:
+            return
+        blk = self.prefix_block
+        sb = self._slot_blocks[slot]
+        for b, key in enumerate(self._prefix_keys(prompt, lp // blk)):
+            if key in self._prefix_pool:
+                self._prefix_pool.move_to_end(key)
+                continue
+            bid = sb[b]
+            self._block_refs[bid] += 1          # the pool's pin
+            self._prefix_pool[key] = bid
+            self.prefix_stats["inserted_blocks"] += 1
+        while len(self._prefix_pool) > self.prefix_cache_blocks:
+            _, bid = self._prefix_pool.popitem(last=False)
+            self._release_block(bid)
+            self.prefix_stats["evictions"] += 1
+
+    def reset_prefix_cache(self) -> None:
+        while self._prefix_pool:
+            _, bid = self._prefix_pool.popitem(last=False)
+            self._release_block(bid)
+        for k in self.prefix_stats:
+            self.prefix_stats[k] = 0
+        for k in self._paged_counters:
+            self._paged_counters[k] = 0
+
+    # ------------------------------------------------------------- decode
+    def advance(self, only=None) -> np.ndarray:
+        mask = self.active if only is None else np.asarray(only, np.bool_)
+        live = self.lengths[mask]
+        if live.size and int(live.max()) >= self.max_len:
+            raise SlotOverflow(
+                f"active slot at length {int(live.max())} would write past "
+                f"max_len={self.max_len}; the scheduler must bound "
+                f"prompt + max_new_tokens at admission")
+        for slot in np.flatnonzero(mask):
+            pos = int(self.lengths[slot])
+            self._ensure_writable(int(slot), pos, pos + 1)
+        t0 = time.perf_counter()
+        self.cache, nxt = self._step(
+            self.params, self.cache, self._put_vec(self.tokens),
+            self._put_vec(self.lengths),
+            self._put_vec(mask), self._masked_bt(mask), self._next_rng())
+        nxt = np.asarray(nxt)
+        self._phase_s["decode_s"] += time.perf_counter() - t0
+        self.lengths[mask] += 1
+        self.tokens = nxt.astype(np.int32)
+        return nxt
+
+    def verify_block(self, block) -> np.ndarray:
+        if not self.greedy:
+            raise ValueError(
+                "verify_block requires greedy sampling: the exact "
+                "acceptance rule (accept while draft == target argmax) "
+                "only exists for greedy decode")
+        block = np.asarray(block, np.int32)
+        if block.ndim != 2 or block.shape[0] != self.slots:
+            raise ValueError(
+                f"block must be (slots, width) = ({self.slots}, k+1), "
+                f"got {block.shape}")
+        width = int(block.shape[1])
+        live = self.lengths[self.active]
+        if live.size and int(live.max()) + width > self.max_len:
+            raise SlotOverflow(
+                f"verify width {width} at length {int(live.max())} would "
+                f"write past max_len={self.max_len}; the scheduler must "
+                f"cap the draft k by remaining slot capacity")
+        for slot in np.flatnonzero(self.active):
+            pos = int(self.lengths[slot])
+            self._ensure_writable(int(slot), pos, pos + width)
+        if width not in self._verifies:
+            self._verifies[width] = self._verify(width)
+        blk = jnp.asarray(block)
+        if self._blk_sharding is not None:
+            blk = jax.device_put(blk, self._blk_sharding)
+        t0 = time.perf_counter()
+        self.cache, g = self._verifies[width](
+            self.params, self.cache, blk, self._put_vec(self.lengths),
+            self._masked_bt(self.active))
+        g = np.asarray(g).astype(np.int32)
+        self._phase_s["decode_s"] += time.perf_counter() - t0
+        return g
+
+    # --------------------------------------------------------- accounting
+    def kv_bytes_per_slot(self) -> int:
+        """HONEST paged capacity (the BASELINE stored-bytes rule): bytes
+        actually backing live sequences — allocated pool blocks (K/V
+        payload + int8 scales) plus the block tables — amortized over
+        live (active or reserved) slots.  With nothing live this is the
+        pool-warmth floor: whatever the prefix pool still pins, plus the
+        tables.  The monolithic ``slots × max_len`` formula would claim
+        capacity the pool never allocated."""
+        per_block = sum(
+            (int(leaf.size) // leaf.shape[0])
+            * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(self.cache))
+        table_bytes = self.block_tables_np.nbytes
+        live = int(self.active.sum()) + int(self.reserved.sum())
+        return (self.blocks_in_use * per_block
+                + table_bytes) // max(live, 1)
+
+    def paged_stats(self) -> dict:
+        """Pool utilization + the zero-copy/CoW ledger (cumulative; the
+        scheduler reads counter deltas per run)."""
+        return {"num_blocks": self.num_blocks,
+                "block": self.paged_block,
+                "blocks_in_use": self.blocks_in_use,
+                "utilization": self.blocks_in_use / self.num_blocks,
+                **dict(self._paged_counters)}
+
+    def compiled_programs(self) -> dict[str, int]:
+        """Paged program inventory: ONE decode step, one chunk program
+        per bucket (admission always chunks — there is no monolithic
+        slice-out prefill over a shared pool), no prefix block-copy
+        programs (hits are pointer writes), one verify program per
+        width, plus at most one CoW block copy."""
+        out = super().compiled_programs()
+        out["paged_block_copies"] = 0 if self._copy_block is None else 1
+        return out

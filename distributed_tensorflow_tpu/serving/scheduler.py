@@ -428,6 +428,10 @@ class ContinuousBatcher:
         before = kv.prefill_tokens_computed
         with tracer.span("prefill", rid=req.rid, prompt_len=lp):
             slot, first = kv.insert(req.prompt)
+        if hasattr(kv, "note_admission"):
+            # register the paged block budget (prompt + decode growth) so
+            # can_admit's outstanding ledger covers this slot's worst case
+            kv.note_admission(slot, lp + req.max_new_tokens)
         self.clock.on_prefill(kv.prefill_tokens_computed - before)
         now = self.clock.now()
         result = RequestResult(
@@ -457,6 +461,8 @@ class ContinuousBatcher:
                                max_new_tokens=req.max_new_tokens)
         req_attrs = req_span.__enter__() or {}
         slot, reused = kv.begin_insert(req.prompt)
+        if hasattr(kv, "note_admission"):
+            kv.note_admission(slot, lp + req.max_new_tokens)
         pending[slot] = {"req": req, "span": req_span, "lp": lp,
                          "admitted_s": t_claim, "reused": reused,
                          "attrs": req_attrs,
@@ -607,6 +613,24 @@ class ContinuousBatcher:
             while can_admit and kv.free_slots:
                 req = queue.pop_ready(clock.now())
                 if req is None:
+                    break
+                # paged block-exhaustion gate: a free SLOT is not enough
+                # when the kv is a block pool — the request's worst-case
+                # block need (prompt + max_new_tokens, plus live slots'
+                # committed budgets) must fit the free list.  Deferral
+                # pushes the request back (FIFO by arrival is preserved:
+                # the queue re-sorts) until decode completions release
+                # blocks.  With NOTHING in flight the pool is as free as
+                # it will ever get, so deferring would busy-spin — admit
+                # and let BlockPoolExhausted surface the impossible
+                # configuration instead.
+                if (hasattr(kv, "can_admit") and (live or pending)
+                        and not kv.can_admit(
+                            int(np.asarray(req.prompt).reshape(-1)
+                                .shape[0]),
+                            req.max_new_tokens)):
+                    queue.push(req)
+                    self._block_deferrals += 1
                     break
                 if self.prefill_chunk:
                     self._begin_admit(req, pending)
@@ -791,6 +815,7 @@ class ContinuousBatcher:
         self._registry = MetricsRegistry()
         self._shed_count = 0
         self._shed_rids: list[int] = []
+        self._block_deferrals = 0   # paged pool admission deferrals
         self._preempted: str | None = None
         # speculative-decode ledger (zeros when no draft is attached):
         # conservation is exact — accepted + rejected == proposed
@@ -805,6 +830,11 @@ class ContinuousBatcher:
         prefix_before = self.kv.prefix_cache_stats()
         prefill_before = self.kv.prefill_tokens_computed
         phases_before = self.kv.phase_times()
+        # paged-pool counter snapshot (zero-copy/CoW are cumulative on the
+        # kv — bench windows share one pool — so the summary reports
+        # deltas over THIS run, like the prefix-pool ledger above)
+        paged_before = (self.kv.paged_stats()
+                        if hasattr(self.kv, "paged_stats") else None)
         with queue.claim():
             self.clock.start()
             t_start = self.clock.now()
@@ -870,6 +900,27 @@ class ContinuousBatcher:
             prefix_sec["cached_blocks"] = prefix_after["cached_blocks"]
             asked = prefix_sec["hits"] + prefix_sec["misses"]
             hit_rate = prefix_sec["hits"] / asked if asked else 0.0
+        # paged-pool accounting: utilization is CURRENT pool state
+        # (blocks still backing live/pinned data), the zero-copy/CoW
+        # ledger is the delta over this run.  zero-copy hit rate = aliased
+        # blocks over blocks asked of the prefix pool — the fraction of
+        # reusable prefix KV shared by POINTER instead of copied.
+        paged_sec = zero_copy_rate = None
+        if paged_before is not None:
+            paged_after = self.kv.paged_stats()
+            paged_sec = {
+                k: paged_after[k] - paged_before.get(k, 0)
+                for k in ("zero_copy_hits", "zero_copy_blocks",
+                          "zero_copy_tokens", "cow_copies")}
+            paged_sec["num_blocks"] = paged_after["num_blocks"]
+            paged_sec["block"] = paged_after["block"]
+            paged_sec["blocks_in_use"] = paged_after["blocks_in_use"]
+            paged_sec["utilization"] = paged_after["utilization"]
+            paged_sec["block_deferrals"] = self._block_deferrals
+            if prefix_sec is not None:
+                asked = prefix_sec["hits"] + prefix_sec["misses"]
+                zero_copy_rate = (paged_sec["zero_copy_blocks"] / asked
+                                  if asked else 0.0)
         return {
             "mode": self.mode,
             "requests": len(results),
@@ -881,6 +932,19 @@ class ContinuousBatcher:
             # shrink); both ride into the serve report section
             "serve_kv_dtype": getattr(self.kv, "kv_dtype", None),
             "serve_kv_bytes_per_slot": self.kv.kv_bytes_per_slot(),
+            # --serve-kv-layout: paged pool accounting (None/0 under
+            # monolithic — the keys are always present so `analyze diff`
+            # gates them when both runs page).  blocks_in_use is gated
+            # lower (fewer physical blocks for the same streams = the
+            # aliasing working), zero-copy rate higher.
+            "serve_kv_layout": getattr(self.kv, "kv_layout", "monolithic"),
+            "serve_kv_blocks_in_use": (paged_sec["blocks_in_use"]
+                                       if paged_sec else None),
+            "serve_kv_block_utilization": (paged_sec["utilization"]
+                                           if paged_sec else None),
+            "serve_prefix_zero_copy_hit_rate": zero_copy_rate,
+            "serve_kv_block_deferrals": self._block_deferrals,
+            "paged": paged_sec,
             # speculative decoding (draft-k → verify-1): accept rate over
             # THIS run's proposals (None: no draft attached — the key is
             # always present so `analyze diff` gates it when both runs
