@@ -324,6 +324,29 @@ def _probe_elastic_resume(ckpt_mgr, eng, sample_x, *, seed: int,
         return None
 
 
+def _train_step_ledger_probe(eng, state, xs, ys):
+    """Train-step memory/compile accounting (observability/xla_stats):
+    AOT-compile the engine's jitted step once, time the compile, read the
+    executable's ``memory_analysis`` through a ProgramLedger.  Returns
+    ``(peak_hbm_bytes_est, compile_total_s, compiled)`` — all None on any
+    failure (private ``_step_fn``, exotic engines); a probe must never
+    kill the bench line.  The compiled executable is returned so callers
+    reuse it (cost_analysis) at zero extra compiles."""
+    try:
+        from distributed_tensorflow_tpu.observability import ProgramLedger
+
+        t0 = time.perf_counter()
+        compiled = eng._step_fn.lower(state, xs, ys).compile()
+        ledger = ProgramLedger()
+        ledger.capture("train_step", compiled,
+                       compile_s=time.perf_counter() - t0)
+        manifest = ledger.manifest()
+        return (manifest["peak_hbm_bytes_est"] or None,
+                round(manifest["compile_total_s"], 6), compiled)
+    except Exception:
+        return None, None, None
+
+
 # ---------------------------------------------------------------------------
 # default mode: training throughput + MFU
 # ---------------------------------------------------------------------------
@@ -525,13 +548,21 @@ def bench_throughput(grad_compression: str = "none",
     mfu = (scan_med * flops_ex) / (n * peak) if peak else None
 
     # XLA's own count for the whole per-device step program (cross-check;
-    # includes elementwise/optimizer FLOPs the analytic model excludes)
+    # includes elementwise/optimizer FLOPs the analytic model excludes).
+    # The same compiled executable feeds the program ledger: its
+    # memory_analysis (peak_hbm_bytes_est) and the measured AOT compile
+    # wall time ride the bench line at zero extra compiles — the
+    # `analyze diff` memory/compile gates (BASELINE.md "Memory/compile
+    # accounting")
     xla_flops = None
-    try:  # needs the engine's jitted step for lower(); private but guarded
-        ca = eng._step_fn.lower(state, xs, ys).compile().cost_analysis()
+    peak_hbm, compile_total_s, compiled = _train_step_ledger_probe(
+        eng, state, xs, ys)
+    try:
+        ca = compiled.cost_analysis() if compiled is not None else None
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
-        xla_flops = float(ca.get("flops", 0.0)) or None
+        if ca is not None:
+            xla_flops = float(ca.get("flops", 0.0)) or None
     except Exception:
         pass
 
@@ -614,6 +645,10 @@ def bench_throughput(grad_compression: str = "none",
         "mfu": round(mfu, 4) if mfu is not None else None,
         "flops_per_example_analytic": int(flops_ex),
         "xla_flops_per_step": xla_flops,
+        # train-step program memory/compile accounting (same executable
+        # as xla_flops_per_step; None when the AOT probe failed)
+        "peak_hbm_bytes_est": peak_hbm,
+        "compile_total_s": compile_total_s,
         "device": device_kind,
         "n_devices": n,
         "global_batch": global_batch,
@@ -744,6 +779,11 @@ def bench_stream(steps: int = 100, grad_compression: str = "none",
     state = trainer.state
     fit_st = trainer_fit.get("step_time", {})
 
+    # train-step program memory/compile accounting (same probe as the
+    # default line; the stream path reuses the last resident batch)
+    peak_hbm, compile_total_s, _ = _train_step_ledger_probe(
+        eng, state, xs, ys)
+
     # host-only producer rate: the C++ gather pool vs the numpy gather,
     # device out of the loop entirely (this is where the prefetcher acts;
     # the end-to-end rows above also carry host→device transfer)
@@ -796,6 +836,8 @@ def bench_stream(steps: int = 100, grad_compression: str = "none",
            if health == "on" else {}),
         "trainer_examples_per_sec": round(
             trainer_fit["examples"] / trainer_fit["elapsed"], 1),
+        "peak_hbm_bytes_est": peak_hbm,
+        "compile_total_s": compile_total_s,
         **{f"producer_{k}_rows_per_sec": round(v, 1)
            for k, v in producer.items()},
         "producer_native_vs_python": (
@@ -1438,6 +1480,37 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
             draft_kv = SlotKVCache(draft_model, draft_params, slots,
                                    mesh=mesh)
 
+    def _serve_ledger_probe():
+        """Serving memory/compile accounting (observability/xla_stats):
+        compile the production table config's decode + prefill programs
+        once through a ProgramLedger on a THROWAWAY table — the timed
+        windows stay ledger-free (the observed-jit's per-call signature
+        hashing must not ride the latency percentiles).  Returns
+        (peak_hbm_bytes_est, compile_total_s), None/None on failure —
+        a probe must never kill the bench line."""
+        try:
+            from distributed_tensorflow_tpu.observability import (
+                ProgramLedger)
+
+            ledger = ProgramLedger()
+            t = SlotKVCache(model, params, slots, mesh=mesh,
+                            kv_dtype=resolved_kv_dtype,
+                            prefix_cache_blocks=cache_blocks,
+                            prefix_block=prefix_block, ledger=ledger,
+                            **layout_kwargs)
+            slot, _ = t.begin_insert(
+                np.asarray(prompts[0], np.int32))
+            while t.prefill_chunk(slot, chunk or None) is None:
+                pass
+            t.advance()
+            t.evict(slot)
+            m = ledger.manifest()
+            return (m["peak_hbm_bytes_est"] or None,
+                    round(m["compile_total_s"], 6))
+        except Exception as e:  # noqa: BLE001
+            note(f"ledger probe failed: {type(e).__name__}: {e}")
+            return None, None
+
     def _warm():
         # compile the decode step + every prefill bucket AND chunk bucket
         # the workload can hit, outside the timed windows (first-request
@@ -1700,6 +1773,7 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
             "serve_ttft_p95_s", "serve_ttft_p99_s", "serve_itl_p50_s",
             "serve_itl_p95_s", "serve_itl_p99_s",
             "serve_goodput_under_slo", "serve_shed_rate")}
+        peak_hbm, ledger_compile_s = _serve_ledger_probe()
         rps = line["serve_requests_per_sec_per_chip"]
         chaos_fl = (chaos or {}).get("serve_fleet") or {}
         print(json.dumps({
@@ -1715,6 +1789,10 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
             **{k: (round(v, 6) if isinstance(v, float) else v)
                for k, v in line.items()},
             "replicas": replicas,
+            # per-replica decode/prefill program footprint + compile cost
+            # (one replica's table; N replicas hold N copies)
+            "peak_hbm_bytes_est": peak_hbm,
+            "compile_total_s": ledger_compile_s,
             "serve_fleet": clean[0].get("serve_fleet"),
             # the failover gate keys come from the CHAOS window (the
             # clean window has no failovers to measure)
@@ -1983,6 +2061,9 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                   "serve_kv_blocks_in_use", "serve_kv_block_utilization",
                   "serve_prefix_zero_copy_hit_rate")
     line = {k: med(cont, k) for k in serve_keys}
+    # serving program memory/compile accounting — probed outside the
+    # timed windows on a throwaway ledger-observed table
+    peak_hbm, ledger_compile_s = _serve_ledger_probe()
     rps = line["serve_requests_per_sec_per_chip"]
     static_rps = med(stat, "serve_requests_per_sec_per_chip")
     mono_itl95 = med(mono, "serve_itl_p95_s")
@@ -2011,6 +2092,11 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
         # conservation identity) and the same-trace model-dtype baseline
         # when --serve-kv-dtype is set
         "serve_kv_dtype": (cont[0].get("serve_kv_dtype")),
+        # round 17: decode/prefill program footprint (memory_analysis,
+        # summed per program) + measured compile seconds of the
+        # production table config — the `analyze diff` memory gates
+        "peak_hbm_bytes_est": peak_hbm,
+        "compile_total_s": ledger_compile_s,
         "speculative": cont[0].get("speculative"),
         "kv_baseline": kv_cmp_line,
         "slo": {"ttft_s": slo_ttft, "itl_s": slo_itl, "quantile": 0.99,

@@ -512,6 +512,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "gauges, with run/host/process ids (README "
                         "'Observability'); span names are mirrored into "
                         "XProf when --profile-dir is also set")
+    p.add_argument("--timeline", action="store_true",
+                   help="time-series gauge sampler + XLA program ledger "
+                        "(README 'Timeline & memory observability'): queue "
+                        "depth / KV blocks / replica load series sampled at "
+                        "existing loop boundaries (bounded rings, "
+                        "self-measured overhead), plus per-compiled-program "
+                        "memory_analysis and compile wall-time in the run "
+                        "report ('xla' section, peak_hbm_bytes_est / "
+                        "compile_total_s).  Host-side only — off compiles "
+                        "the exact pre-timeline program set.  Renders "
+                        "offline via `analyze timeline` / "
+                        "`analyze programs` and as Perfetto counter tracks")
+    p.add_argument("--timeline-interval", type=float, default=0.05,
+                   metavar="SECONDS",
+                   help="minimum seconds between --timeline samples per "
+                        "gauge group (default 0.05; 0 = record every "
+                        "boundary crossing)")
     p.add_argument("--profile-dir", default=None,
                    help="write an XLA profiler trace here (TensorBoard/XProf)")
     p.add_argument("--dtype", default="float32",
@@ -671,6 +688,8 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         max_steps_per_lease=args.max_steps_per_lease,
         metrics_path=args.metrics_path,
         trace_path=args.trace,
+        timeline=args.timeline,
+        timeline_interval=args.timeline_interval,
         profile_dir=args.profile_dir,
         dtype=args.dtype,
         watchdog_timeout=args.watchdog_timeout,

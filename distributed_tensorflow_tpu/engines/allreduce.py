@@ -122,7 +122,7 @@ class Trainer:
             on_anomaly: str = "warn",
             should_stop: Callable[[int], str | None] | None = None,
             data_state: dict | None = None,
-            straggler_detector=None) -> dict:
+            straggler_detector=None, timeline=None) -> dict:
         """Train; returns {'elapsed': seconds_around_fit, 'steps': n, ...} —
         the reference's only training metrics (reference dist_keras.py:41-49).
 
@@ -741,6 +741,14 @@ class Trainer:
                             # XLA compile over its k entries)
                             dt = (now - max(t_disp, t_mark)) / n_chunk
                             t_mark = now
+                            if timeline is not None:
+                                # --timeline: chunk step-time + prefetch
+                                # depth series at the SAME boundary the
+                                # gauges above use — no extra syncs
+                                timeline.sample_many(
+                                    {"chunk_step_time_s": dt,
+                                     "prefetch_depth": pf.queue_depth},
+                                    group="trainer")
                             timer.times.extend([dt] * n_chunk)
                             if straggler_detector is not None:
                                 # per-chunk average step time vs the

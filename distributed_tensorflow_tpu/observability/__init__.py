@@ -31,6 +31,15 @@ fast path instead of disabling it:
   slo      — SLOMonitor: goodput-under-SLO accounting (requests/sec
              meeting BOTH the TTFT and ITL targets; shed requests are
              offered load, never goodput).
+  timeline — GaugeSeries / Timeline: bounded-ring time-series gauges
+             (O(1) record, exact merge, per-series interval throttle,
+             self-measured overhead) sampled at existing iteration
+             boundaries — the autoscaler's sensor substrate, rendered by
+             ``analyze timeline`` and the Perfetto counter tracks.
+  xla_stats— ProgramLedger: per-compiled-program XLA memory_analysis +
+             compile wall-time (``ledger.jit`` observes a call site's
+             compiles; flag off = literal ``jax.jit``), with a manifest
+             the ``analyze programs`` drift gate diffs.
   analyze  — the offline read side: span aggregation, stall summaries,
              Chrome-trace-event export (Perfetto-loadable), health
              timelines, and the run-vs-run regression diff.  Stdlib-only,
@@ -51,21 +60,30 @@ from distributed_tensorflow_tpu.observability.report import (
 from distributed_tensorflow_tpu.observability.sink import (
     SCHEMA_VERSION, AsyncJsonlSink)
 from distributed_tensorflow_tpu.observability.slo import SLOMonitor
+from distributed_tensorflow_tpu.observability.timeline import (
+    GaugeSeries, Timeline, sparkline)
 from distributed_tensorflow_tpu.observability.trace import (
     NULL_TRACER, Tracer)
+from distributed_tensorflow_tpu.observability.xla_stats import (
+    ProgramLedger, diff_manifests)
 
 __all__ = [
     "AsyncJsonlSink",
+    "GaugeSeries",
     "HealthConfig",
     "LogHistogram",
     "MetricsRegistry",
     "NULL_TRACER",
+    "ProgramLedger",
     "SCHEMA_VERSION",
     "SLOMonitor",
+    "Timeline",
     "Tracer",
     "build_run_report",
+    "diff_manifests",
     "runtime_environment",
     "serve_section",
+    "sparkline",
     "exact_percentile",
 ]
 

@@ -15,6 +15,16 @@ answers:
   diff BASE NEW            run-vs-run regression diff of two run reports
                            (or BENCH_*.json lines); exits nonzero iff a
                            metric regressed beyond --threshold
+  timeline TRACE.jsonl     the --timeline gauge series (queue depth, KV
+                           blocks, replica load, chunk step time) rendered
+                           as text sparklines per series — per-replica
+                           lanes grouped — from the trace file alone;
+                           --json emits the exact summaries instead
+  programs REPORT          the --timeline XLA program ledger: per-program
+                           memory_analysis bytes + compile seconds; with
+                           --against BASE it becomes the drift gate —
+                           exit nonzero when the program set grew or a
+                           program's temp bytes grew past --temp-threshold
 
 Inputs are whatever the sinks wrote: a trace JSONL (``--trace``), a metrics
 JSONL (``--metrics-path``), a result JSONL (``--result-path``), the
@@ -36,6 +46,13 @@ import math
 import sys
 from pathlib import Path
 from typing import Any, Iterable
+
+# sibling pure-host modules (no jax, no backend init — same portability
+# contract as this file): the timeline ring buffer/sparkline renderer and
+# the program-manifest differ are the read side's data structures
+from distributed_tensorflow_tpu.observability.timeline import (
+    GaugeSeries, sparkline)
+from distributed_tensorflow_tpu.observability.xla_stats import diff_manifests
 
 
 def read_jsonl(path: str | Path) -> list[dict]:
@@ -163,6 +180,23 @@ def to_chrome_trace(records: list[dict]) -> dict[str, Any]:
         tid = int(rec.get("pid", 0))
         procs.setdefault(pid, f"{rec.get('host', '?')} "
                               f"(process {pid}, run {rec.get('run', '?')})")
+        if kind == "event" and rec.get("name") == "timeline_series":
+            # --timeline bulk series → one counter-track sample per ring
+            # entry.  Per-replica series get their own pid LANE (Perfetto
+            # groups counter tracks by pid), so a fleet trace shows each
+            # replica's queue depth / KV blocks as parallel lanes with a
+            # named header instead of one interleaved mess.
+            replica = rec.get("replica")
+            cpid = pid if replica is None else _TIMELINE_PID_BASE + replica
+            if replica is not None:
+                procs.setdefault(cpid, f"replica {replica} (timeline)")
+            series = rec.get("series", "?")
+            for t_mono, _wall, value in rec.get("samples", ()):
+                events.append({"name": series, "cat": "timeline",
+                               "ph": "C", "ts": float(t_mono) * 1e6,
+                               "pid": cpid, "tid": 0,
+                               "args": {series: _json_safe(value)}})
+            continue
         ts = float(rec["t"]) * 1e6
         drop = {"event", "name", "t", "dur_s", "run", "host", "pid",
                 "process", "schema_version"}
@@ -192,6 +226,100 @@ def to_chrome_trace(records: list[dict]) -> dict[str, Any]:
     meta = [{"name": "process_name", "ph": "M", "pid": pid, "ts": 0,
              "args": {"name": label}} for pid, label in sorted(procs.items())]
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+# per-replica timeline counter lanes: offset far above any real JAX
+# process index so fleet lanes never collide with pod processes
+_TIMELINE_PID_BASE = 100000
+
+
+# ------------------------------------------------------ timeline (gauges)
+
+def timeline_series(records: Iterable[dict]) -> dict[str, GaugeSeries]:
+    """Rebuild the run's gauge series from the trace's bulk
+    ``timeline_series`` events (Timeline.emit): {series_key: GaugeSeries},
+    per-replica series under their ``name@rN`` key.  Lossless — the
+    events carry the exact totals alongside the retained ring."""
+    out: dict[str, GaugeSeries] = {}
+    for rec in records:
+        if rec.get("event") != "event" \
+                or rec.get("name") != "timeline_series":
+            continue
+        name = rec.get("series", "?")
+        replica = rec.get("replica")
+        key = name if replica is None else f"{name}@r{replica}"
+        g = GaugeSeries.from_dict({
+            "capacity": rec.get("capacity", 512),
+            "samples": rec.get("samples", []),
+            "count": rec.get("count",
+                             len(rec.get("samples", []))
+                             + int(rec.get("dropped", 0) or 0)),
+            "sum": rec.get("sum", 0.0),
+            "vmin": rec.get("vmin"),
+            "vmax": rec.get("vmax"),
+        })
+        if key in out:      # several windows in one trace (bench/sweep)
+            out[key].merge(g)
+        else:
+            out[key] = g
+    return out
+
+
+def timeline_summary(records: list[dict]) -> dict[str, Any]:
+    """JSON summary of a trace's timeline: per-series digests
+    (GaugeSeries.summary) plus the sampler's self-measured overhead from
+    the ``timeline_overhead`` event."""
+    series = timeline_series(records)
+    overhead = None
+    for rec in records:
+        if rec.get("event") == "event" \
+                and rec.get("name") == "timeline_overhead":
+            overhead = (overhead or 0.0) + float(rec.get("overhead_s", 0.0))
+    return {
+        "series": {k: s.summary() for k, s in sorted(series.items())},
+        "series_n": len(series),
+        "overhead_s": overhead,
+    }
+
+
+def render_timeline_text(records: list[dict], width: int = 60) -> str:
+    """Sparkline rendering of every timeline series — one line per
+    series, per-replica lanes grouped under their base name, retained
+    window min→max annotated.  Stdlib glyphs only."""
+    series = timeline_series(records)
+    if not series:
+        return "(no timeline_series events in trace — run with --timeline)"
+    out = []
+    namew = max(len(k) for k in series)
+    for key in sorted(series):
+        s = series[key]
+        d = s.summary()
+        drop = f" (+{d['dropped']} dropped)" if d["dropped"] else ""
+        out.append(
+            f"{key:>{namew}} |{sparkline(s.values(), width):<{width}}| "
+            f"min={d['min']:g} max={d['max']:g} last={d['last']:g} "
+            f"n={d['count']}{drop}")
+    summ = timeline_summary(records)
+    if summ["overhead_s"] is not None:
+        out.append(f"sampler overhead: {summ['overhead_s'] * 1e3:.3f} ms")
+    return "\n".join(out)
+
+
+# -------------------------------------------------- XLA program manifests
+
+def extract_manifest(report: dict[str, Any]) -> dict[str, Any]:
+    """The program-ledger manifest from any artifact shape: a bare
+    manifest (``analyze programs`` against another run's saved manifest),
+    a run report carrying the ``xla`` section, or a summary whose nested
+    run_report carries it (load_report already flattened that case)."""
+    if isinstance(report.get("programs"), dict):
+        return report
+    xla = report.get("xla")
+    if isinstance(xla, dict) and isinstance(xla.get("programs"), dict):
+        return xla
+    raise ValueError(
+        "no XLA program manifest found (expected a 'programs' dict or an "
+        "'xla' section — was the run launched with --timeline?)")
 
 
 # ------------------------------------------------------- serving waterfall
@@ -554,6 +682,24 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     # admissions are paying prefill for KV the pool already holds.
     ("serve_kv_blocks_in_use", "lower"),
     ("serve_prefix_zero_copy_hit_rate", "higher"),
+    # timeline + XLA ledger (round 17; BASELINE.md "Memory/compile
+    # accounting"): the summed per-program HBM estimate is the
+    # capacity-per-chip number every KV/precision optimization exists to
+    # shrink, and total compile seconds at equal work growing means a
+    # program-set or cache regression.  The telemetry's own cost is gated
+    # too — sink drops are lost observability records, the trace/sampler
+    # overheads are the "<1% of wall" budget measured (all lower).
+    ("peak_hbm_bytes_est", "lower"),
+    ("compile_total_s", "lower"),
+    ("sink_dropped", "lower"),
+    ("serve_sink_dropped", "lower"),
+    ("serve_trace_overhead_s", "lower"),
+    ("timeline_overhead_s", "lower"),
+    # queue-depth area (requests·s of queueing over the window) and the
+    # KV block-footprint p95 — the autoscaler's target signals; at equal
+    # offered load, growth is an admission/capacity regression
+    ("queue_depth_auc", "lower"),
+    ("kv_blocks_in_use_p95", "lower"),
 )
 
 
@@ -608,6 +754,24 @@ def load_report(path: str | Path) -> dict[str, Any]:
         for key, value in serve.items():
             if key.startswith("serve_"):
                 flat.setdefault(key, value)
+        # the --timeline gauge digests ride the serve section under their
+        # own names (batcher/fleet summary keys, no serve_ prefix) —
+        # surface the gated ones flat
+        for key in ("queue_depth_auc", "kv_blocks_in_use_p95",
+                    "timeline_overhead_s"):
+            if isinstance(serve.get(key), (int, float)):
+                flat.setdefault(key, serve[key])
+        # fleet-mode telemetry self-accounting (serve_fleet subsection)
+        fleet = serve.get("serve_fleet")
+        if isinstance(fleet, dict) \
+                and isinstance(fleet.get("sink_dropped"), (int, float)):
+            flat.setdefault("sink_dropped", fleet["sink_dropped"])
+    # the run report's trace-sink health: drops are lost observability
+    # records — surfaced flat for the lower-is-better gate
+    trace = flat.get("trace")
+    if isinstance(trace, dict) \
+            and isinstance(trace.get("dropped"), (int, float)):
+        flat.setdefault("sink_dropped", trace["dropped"])
     return flat
 
 
@@ -713,6 +877,28 @@ def main(argv: list[str] | None = None) -> int:
     df.add_argument("--threshold", type=float, default=0.1,
                     help="relative regression threshold (default 0.1)")
 
+    tl = sub.add_parser("timeline", help="--timeline gauge series as "
+                                         "text sparklines (per-replica "
+                                         "lanes) from the trace alone")
+    tl.add_argument("trace", help="trace JSONL of a --timeline run")
+    tl.add_argument("--json", action="store_true",
+                    help="emit the per-series summaries as JSON instead")
+    tl.add_argument("--width", type=int, default=60,
+                    help="sparkline width in characters")
+
+    pg = sub.add_parser("programs",
+                        help="--timeline XLA program ledger: memory/"
+                             "compile manifest; --against BASE = drift "
+                             "gate (exit 1 on added programs or temp-"
+                             "bytes growth)")
+    pg.add_argument("report", help="run report / summary JSON(L) with an "
+                                   "'xla' section, or a bare manifest")
+    pg.add_argument("--against", default=None, metavar="BASE",
+                    help="baseline report/manifest to diff against")
+    pg.add_argument("--temp-threshold", type=float, default=0.10,
+                    help="relative temp-bytes growth that fails the gate "
+                         "(default 0.10)")
+
     args = p.parse_args(argv)
     if args.cmd == "spans":
         print(json.dumps(trace_summary(read_jsonl(args.trace)), indent=2))
@@ -739,6 +925,32 @@ def main(argv: list[str] | None = None) -> int:
             max_update_ratio=args.max_update_ratio,
             loss_spike_factor=args.spike_factor), indent=2))
         return 0
+    if args.cmd == "timeline":
+        records = read_jsonl(args.trace)
+        if args.json:
+            print(json.dumps(timeline_summary(records), indent=2))
+        else:
+            print(render_timeline_text(records, width=args.width))
+        return 0
+    if args.cmd == "programs":
+        current = extract_manifest(load_report(args.report))
+        if args.against is None:
+            print(json.dumps(current, indent=2))
+            return 0
+        base = extract_manifest(load_report(args.against))
+        findings = diff_manifests(current, base,
+                                  temp_threshold=args.temp_threshold)
+        failed = [f for f in findings if f.get("severity") == "fail"]
+        print(json.dumps({"findings": findings,
+                          "failed": len(failed),
+                          "temp_threshold": args.temp_threshold,
+                          "program_count": {
+                              "base": len(base.get("programs", {})),
+                              "new": len(current.get("programs", {}))}},
+                         indent=2))
+        # the drift gate: growth in the program set or in a program's
+        # temp bytes past threshold fails CI; removals are informational
+        return 1 if failed else 0
     # diff: 0 = no regression, 1 = regression past threshold, 2 = nothing
     # was compared (mismatched bench metrics, or inputs sharing no known
     # metric keys — e.g. an operator diffing two trace files).  A 0 on an

@@ -59,13 +59,16 @@ def runtime_environment() -> dict[str, Any]:
 
 
 def serve_section(summary: dict[str, Any] | None,
-                  n_devices: int = 1) -> dict[str, Any] | None:
+                  n_devices: int = 1, tracer=None) -> dict[str, Any] | None:
     """Normalize a ContinuousBatcher summary into the run-report/bench
     ``serve`` section: the per-request result objects are dropped (the
     section must stay JSON), and the per-chip rates — requests/sec (the
     round-7 headline) and goodput-under-SLO (the round-13 one, mirroring
     examples_per_sec_per_device) — are derived here so every surface
-    divides by the same device count."""
+    divides by the same device count.  ``tracer`` (when enabled) adds the
+    serve window's telemetry self-accounting — sink drop counter + span
+    bookkeeping overhead, previously train-report-only — gated
+    lower-is-better by `analyze diff`."""
     if summary is None:
         return None
     sec = {k: v for k, v in summary.items() if k != "results"}
@@ -74,12 +77,18 @@ def serve_section(summary: dict[str, Any] | None,
         sec[f"{key}_per_chip"] = (
             v / n_devices if isinstance(v, (int, float)) and n_devices
             else None)
+    if tracer is not None and getattr(tracer, "enabled", False):
+        tstats = tracer.stats()
+        sec["serve_sink_dropped"] = tstats.get("dropped")
+        sec["serve_sink_written"] = tstats.get("written")
+        sec["serve_trace_overhead_s"] = tstats.get("overhead_s", 0.0)
     return sec
 
 
 def build_run_report(fit_result: dict[str, Any], *,
                      watchdog=None, metrics_logger=None, tracer=None,
                      serve: dict[str, Any] | None = None,
+                     timeline=None, ledger=None,
                      ) -> dict[str, Any]:
     """Assemble the run report from the Trainer's fit result and the live
     telemetry objects.  Every argument except ``fit_result`` is optional —
@@ -206,6 +215,42 @@ def build_run_report(fit_result: dict[str, Any], *,
         report["trace"] = None
     if metrics_logger is not None:
         overhead += getattr(metrics_logger, "overhead_s", 0.0)
+
+    # --timeline sections (None when sampling/ledger are off — "disabled"
+    # stays distinguishable from "measured zero"):
+    # * `timeline`: per-series digests + the sampler's own measured cost
+    #   (the < 1% budget is reported, not assumed);
+    # * `xla`: the per-compiled-program memory/compile manifest, with the
+    #   two headline keys — peak_hbm_bytes_est (per-program XLA peak
+    #   estimates SUMMED per run) and compile_total_s (the `compile`
+    #   span total + ledger-observed compiles) — hoisted to the top
+    #   level for `analyze diff`'s lower-is-better gates.
+    compile_span_s = 0.0
+    if tracer is not None and tracer.enabled:
+        compile_span_s = (tracer.span_summary().get("compile") or
+                          {}).get("total_s", 0.0)
+    if timeline is not None:
+        report["timeline"] = {
+            "interval_s": timeline.interval_s,
+            "overhead_s": round(timeline.overhead_s, 6),
+            "overhead_frac": (round(timeline.overhead_s / elapsed, 6)
+                              if elapsed > 0 else None),
+            "series": timeline.summary(),
+        }
+        overhead += timeline.overhead_s
+    else:
+        report["timeline"] = None
+    if ledger is not None:
+        manifest = ledger.manifest()
+        report["xla"] = manifest
+        report["peak_hbm_bytes_est"] = manifest["peak_hbm_bytes_est"]
+        report["compile_total_s"] = round(
+            compile_span_s + manifest["compile_total_s"], 6)
+    else:
+        report["xla"] = None
+        report["peak_hbm_bytes_est"] = None
+        report["compile_total_s"] = (round(compile_span_s, 6)
+                                     if compile_span_s else None)
 
     # execution environment (jax version, device kind, effective XLA
     # flags): bench/report trajectories stay attributable across

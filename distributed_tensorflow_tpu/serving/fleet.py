@@ -696,7 +696,8 @@ class ReplicaSet:
                  draft_k: int = 4, retry_limit: int = 2,
                  retry_backoff_s: float = 0.0,
                  watchdog_timeout_s: float = 0.0,
-                 fault_injector: FaultInjector | None = None):
+                 fault_injector: FaultInjector | None = None,
+                 timeline=None):
         if not kvs:
             raise ValueError("ReplicaSet needs at least one SlotKVCache")
         if draft_kvs is not None and len(draft_kvs) != len(kvs):
@@ -716,6 +717,12 @@ class ReplicaSet:
         self.retry_backoff_s = float(retry_backoff_s)
         self.watchdog_timeout_s = float(watchdog_timeout_s)
         self.fault_injector = fault_injector
+        # --timeline: ONE shared sampler; per-replica series are keyed by
+        # replica id (batchers tag their own series, the coordinator
+        # samples fleet-level load/admitting/backlog gauges).  Concurrent
+        # replica threads write DISTINCT series keys, so the host-side
+        # ring writes never contend on one buffer.
+        self.timeline = timeline
         self.vocab = int(kvs[0].dm.vocab_size)
         self.draft_kvs = draft_kvs
         self._lock = threading.RLock()
@@ -731,7 +738,7 @@ class ReplicaSet:
                 should_stop=(lambda iters, r=replica:
                              self._replica_should_stop(r, iters)),
                 draft_kv=(draft_kvs[i] if draft_kvs is not None else None),
-                draft_k=draft_k)
+                draft_k=draft_k, timeline=timeline, timeline_tag=i)
             self.replicas.append(replica)
             if fault_injector is not None:
                 fault_injector.arm(i, kv)
@@ -769,6 +776,26 @@ class ReplicaSet:
         if (self.min_admitting_replicas is None
                 or admitting < self.min_admitting_replicas):
             self.min_admitting_replicas = admitting
+
+    def _sample_timeline(self) -> None:
+        """Fleet-level --timeline gauges, sampled by the run coordinator
+        at its existing poll boundary: per-replica live load (a killed
+        replica's lane drops to zero — the failover counter cliff the
+        e2e test asserts), admitting-replica count, and the journal's
+        retry backlog.  Pure host reads; None = off."""
+        tl = self.timeline
+        if tl is None or self.journal is None:
+            return
+        for r in self.replicas:
+            load = (self.journal.load.get(r.id, 0)
+                    if r.state == "serving" else 0)
+            tl.sample("replica_load", load, replica=r.id)
+        counts = self.journal.counts()
+        tl.sample_many({
+            "admitting_replicas": len(self._serving()) - self._draining,
+            "journal_pending": counts.get("pending", 0),
+            "journal_retries": self.journal.requeues,
+        }, group="fleet")
 
     def _replica_should_stop(self, replica: _Replica,
                              iters: int) -> str | None:
@@ -1054,6 +1081,7 @@ class ReplicaSet:
                     self._preempted = reason
                     break
             progressed = False
+            self._sample_timeline()
             for replica in self.replicas:
                 if replica.state != "serving":
                     continue
@@ -1180,6 +1208,7 @@ class ReplicaSet:
                             break
                         if not self._serving():
                             break
+                        self._sample_timeline()
                         self._cond.wait(0.05)
             finally:
                 self._wd_stop.set()
@@ -1207,6 +1236,7 @@ class ReplicaSet:
             self.tracer.event("serve_preempted", reason=self._preempted,
                               completed=self.journal.counts()["done"],
                               unserved=self.journal.counts()["unserved"])
+        self._sample_timeline()   # final state (post-failover cliffs)
         elapsed = self.clock.now() - t_start
         return self._summary(offered, elapsed)
 
@@ -1230,6 +1260,7 @@ class ReplicaSet:
         journal = self.journal
         results = journal.results()
         counts = journal.counts()
+        tracer_stats = self.tracer.stats() or {}
         ttfts = [r.ttft_s for r in results]
         itls = [g for r in results for g in r.itl_s]
         tokens = sum(len(e.emitted) for e in journal.entries.values()
@@ -1417,9 +1448,30 @@ class ReplicaSet:
                 "merged_goodput_under_slo": (
                     fleet_good / elapsed
                     if slo is not None and elapsed > 0 else None),
+                # telemetry self-accounting (the fleet shares ONE tracer
+                # across replica workers): sink drop counter + span-
+                # bookkeeping overhead, both gated lower-is-better — a
+                # fleet that drops trace records under load is flying a
+                # partial instrument panel
+                "sink_dropped": tracer_stats.get("dropped", 0),
+                "sink_written": tracer_stats.get("written", 0),
+                "trace_overhead_s": tracer_stats.get("overhead_s", 0.0),
             },
             "results": results,
         }
+        if self.timeline is not None:
+            # timeline-derived fleet keys only when sampling is on — the
+            # flag-off key set stays byte-identical (parity pin)
+            summary["queue_depth_auc"] = sum(
+                filter(None, (self.timeline.stat("queue_depth", "auc",
+                                                 replica=r.id)
+                              for r in self.replicas))) or None
+            summary["kv_blocks_in_use_p95"] = max(
+                filter(lambda v: v is not None,
+                       (self.timeline.stat("kv_blocks_in_use", "p95",
+                                           replica=r.id)
+                        for r in self.replicas)), default=None)
+            summary["timeline_overhead_s"] = self.timeline.overhead_s
         return summary
 
 

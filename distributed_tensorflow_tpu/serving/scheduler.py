@@ -340,7 +340,8 @@ class ContinuousBatcher:
                  clock=None, mode: str = "continuous",
                  prefill_chunk: int = 0, metrics=None, slo=None,
                  queue_cap: int = 0, should_stop=None,
-                 draft_kv: SlotKVCache | None = None, draft_k: int = 4):
+                 draft_kv: SlotKVCache | None = None, draft_k: int = 4,
+                 timeline=None, timeline_tag: int | None = None):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be continuous|static, got {mode}")
         if prefill_chunk < 0:
@@ -403,6 +404,12 @@ class ContinuousBatcher:
         self.slo = slo
         self.queue_cap = int(queue_cap)
         self.should_stop = should_stop
+        # `timeline` (--timeline) is the same discipline: a throttled
+        # host-side gauge sampler fed at the existing per-iteration
+        # boundary; `timeline_tag` is the fleet's replica id, keying
+        # per-replica series lanes.  None = sampling fully off.
+        self.timeline = timeline
+        self.timeline_tag = timeline_tag
         self.idle_polls = 0
 
     # ------------------------------------------------------------ admission
@@ -655,6 +662,17 @@ class ContinuousBatcher:
             # iteration, into the histogram the summary's
             # queue_depth_p95 reads (+ the queue's own high watermark)
             self._registry.record("queue_depth", queue.depth(clock.now()))
+            if self.timeline is not None:
+                # --timeline sampling at the SAME boundary: queue/slot/
+                # prefill pressure plus the kv's host-counter gauges, one
+                # throttled batch per iteration — no device syncs, no new
+                # keys or programs with the flag off
+                self.timeline.sample_many(
+                    {"queue_depth": queue.depth(clock.now()),
+                     "active_slots": len(live),
+                     "prefill_pending": len(pending),
+                     **kv.timeline_gauges()},
+                    replica=self.timeline_tag, group="batcher")
             # at most ONE ≤budget-token chunk rides each iteration: the
             # decode stall a filling prompt can inflict is bounded by the
             # chunk budget, whatever the prompt length
@@ -921,7 +939,7 @@ class ContinuousBatcher:
                 asked = prefix_sec["hits"] + prefix_sec["misses"]
                 zero_copy_rate = (paged_sec["zero_copy_blocks"] / asked
                                   if asked else 0.0)
-        return {
+        summary = {
             "mode": self.mode,
             "requests": len(results),
             "completed": len(results),
@@ -1032,3 +1050,13 @@ class ContinuousBatcher:
                 for k in phases_after},
             "results": results,
         }
+        if self.timeline is not None:
+            # timeline-derived keys ride the summary ONLY when sampling is
+            # on: the flag-off key set stays byte-identical (parity pin)
+            tag = self.timeline_tag
+            summary["queue_depth_auc"] = self.timeline.stat(
+                "queue_depth", "auc", replica=tag)
+            summary["kv_blocks_in_use_p95"] = self.timeline.stat(
+                "kv_blocks_in_use", "p95", replica=tag)
+            summary["timeline_overhead_s"] = self.timeline.overhead_s
+        return summary

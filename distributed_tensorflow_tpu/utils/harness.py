@@ -176,6 +176,18 @@ class ExperimentConfig:
                                            # chunked drain — no downshift)
     trace_path: str | None = None          # structured span/event JSONL
                                            # timeline (observability/trace)
+    timeline: bool = False                 # periodic gauge sampler (queue
+                                           # depth, KV blocks, replica load)
+                                           # + XLA program ledger (per-
+                                           # program memory_analysis,
+                                           # compile wall-time).  Host-side
+                                           # only; off compiles the exact
+                                           # pre-timeline program set
+    timeline_interval: float = 0.05        # min seconds between samples
+                                           # per gauge group (throttle —
+                                           # sampling happens at existing
+                                           # iteration boundaries, never
+                                           # on a timer thread)
     profile_dir: str | None = None         # XLA profiler trace output
     dtype: str = "float32"                 # model compute dtype; 'bfloat16'
                                            # enables mixed precision (params
@@ -1519,6 +1531,10 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     if config.watchdog_abort and config.watchdog_timeout <= 0:
         raise ValueError("watchdog_abort requires watchdog_timeout > 0 "
                          "(nothing would ever detect the stall)")
+    if config.timeline_interval < 0:
+        raise ValueError(f"--timeline-interval must be >= 0 seconds "
+                         f"(0 = sample at every boundary), got "
+                         f"{config.timeline_interval}")
     if config.compile_cache:
         # before any compile: the whole run's programs become cache hits
         # on the next invocation with the same cache dir
@@ -1678,6 +1694,22 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     tracer = Tracer(path=config.trace_path,
                     process_index=jax.process_index())
 
+    # --timeline: the sensor substrate.  One flag arms BOTH halves —
+    # the gauge sampler (Timeline, sampled at boundaries the loops
+    # already cross) and the XLA program ledger (ProgramLedger, riding
+    # the serve path's jit sites via ledger.jit).  Off means the objects
+    # are None at every call site, so the compiled program set and the
+    # summary key set are byte-identical to a pre-timeline run (the
+    # parity pin tests/test_timeline.py enforces).
+    timeline = None
+    ledger = None
+    if config.timeline:
+        from distributed_tensorflow_tpu.observability import (
+            ProgramLedger, Timeline)
+
+        timeline = Timeline(interval_s=config.timeline_interval)
+        ledger = ProgramLedger()
+
     # elastic lease + straggler detection (distributed_tensorflow_tpu/
     # elastic/): every checkpointed run arms the graceful SIGTERM drain —
     # a preemption notice finishes the in-flight chunk, writes a final
@@ -1747,7 +1779,8 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                                                if lease is not None
                                                else None),
                                   data_state=resume_data_state,
-                                  straggler_detector=straggler)
+                                  straggler_detector=straggler,
+                                  timeline=timeline)
         finally:
             if watchdog is not None:
                 watchdog.close()
@@ -1850,7 +1883,9 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                           if lease is not None else None)
             serve_sec = _serve_from_state(config, ex, trainer.state,
                                           test_ds, tracer, total_devices,
-                                          should_stop=serve_stop)
+                                          should_stop=serve_stop,
+                                          timeline=timeline,
+                                          ledger=ledger)
             summary["serve"] = serve_sec
             # supervisor exit policy: a serve window that lost requests
             # (unserved > 0 — lease drain, retry exhaustion, dead fleet)
@@ -1883,9 +1918,16 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
             # drain the async sink first: stats() read mid-drain would
             # report written < records, which reads as silent record loss
             metrics_logger.flush()
+        if timeline is not None:
+            # flush the sampled series into the trace file as bulk
+            # `timeline_series` events — `analyze timeline` and the
+            # Perfetto counter tracks render from the trace alone, no
+            # run report needed
+            timeline.emit(tracer)
         report = build_run_report(fit, watchdog=watchdog,
                                   metrics_logger=metrics_logger,
-                                  tracer=tracer, serve=serve_sec)
+                                  tracer=tracer, serve=serve_sec,
+                                  timeline=timeline, ledger=ledger)
         summary["run_report"] = report
         sink.emit("run_report", **report)
         sink.emit("summary", **summary)
@@ -2224,7 +2266,8 @@ def _validate_serving(config: ExperimentConfig, ex: _Experiment,
 
 def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
                       test_ds, tracer, total_devices: int,
-                      should_stop=None) -> dict[str, Any]:
+                      should_stop=None, timeline=None,
+                      ledger=None) -> dict[str, Any]:
     """--serve N: run a continuous-batching serving window over the
     trained params (serving/SlotKVCache + ContinuousBatcher) and return
     the run report's ``serve`` section.
@@ -2283,6 +2326,12 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
         mesh=mesh, kv_dtype=kv_dtype,
         prefix_cache_blocks=config.serve_prefix_cache,
         prefix_block=config.serve_prefix_block)
+    if ledger is not None:
+        # conditional-kwarg pattern (same as the paged block below): the
+        # flag-off construction stays byte-identical, and with the ledger
+        # on every kv jit site routes through ledger.jit — observed
+        # compiles, memory_analysis captured, same executable dispatched
+        kv_kwargs.update(ledger=ledger)
     if config.serve_kv_layout == "paged":
         # --serve-kv-layout paged: SlotKVCache's __new__ dispatches to
         # PagedSlotKVCache — refcounted block pool, zero-copy prefix
@@ -2357,7 +2406,7 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
             queue_cap=config.serve_queue_cap, slo=slo,
             draft_kvs=draft_kvs, draft_k=config.serve_draft_k,
             watchdog_timeout_s=config.serve_watchdog_s,
-            fault_injector=injector)
+            fault_injector=injector, timeline=timeline)
         if config.serve_hot_swap:
             # the drill: re-install the SAME trained params after half
             # the window — proves drain + swap_generations + N-1
@@ -2373,7 +2422,7 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
                                           should_stop=should_stop)
             finally:
                 replica_set.close()
-        return serve_section(summary, total_devices)
+        return serve_section(summary, total_devices, tracer=tracer)
     with tracer.span("serve", requests=config.serve_requests,
                      slots=config.serve_slots):
         summary = ContinuousBatcher(
@@ -2382,8 +2431,9 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
             slo=slo,
             queue_cap=config.serve_queue_cap,
             should_stop=should_stop,
-            draft_kv=draft_kv, draft_k=config.serve_draft_k).run(requests)
-    return serve_section(summary, total_devices)
+            draft_kv=draft_kv, draft_k=config.serve_draft_k,
+            timeline=timeline).run(requests)
+    return serve_section(summary, total_devices, tracer=tracer)
 
 
 def steps_to_accuracy(
