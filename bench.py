@@ -1263,7 +1263,8 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                 slo_itl: float | None = None, queue_cap: int = 0,
                 kv_dtype: str | None = None, draft: str | None = None,
                 draft_k: int | None = None, replicas: int = 0,
-                kv_layout: str | None = None) -> None:
+                kv_layout: str | None = None,
+                disagg: str | None = None) -> None:
     """Serving throughput + latency percentiles of the continuous-batching
     engine (distributed_tensorflow_tpu/serving/) against the static-batch
     restart-per-``generate`` baseline, on the SAME synthetic open-loop
@@ -1374,6 +1375,19 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
     # seeded decode iteration, emitted as its own line
     replicas = replicas or int(env("BENCH_SERVE_REPLICAS", "0"))
     kill_iter = int(env("BENCH_SERVE_KILL_ITER", "8"))
+    # round 18: --disagg P:D (BENCH_SERVE_DISAGG) — the heterogeneous-
+    # fleet scenario line: a disaggregated P-prefill/D-decode fleet vs
+    # the homogeneous (P+D)-replica fleet on the SAME seeded trace
+    # (disagg_vs_homogeneous_itl_p95/p99 + greedy-token parity), an
+    # affinity-vs-least-loaded router pair on the same trace
+    # (serve_fleet_prefix_hit_rate), and a diurnal burst trace where a
+    # queue-driven autoscaled fleet is compared against the static
+    # sizes it scales between (serve_replica_seconds + the goodput
+    # fraction of the best static)
+    disagg = disagg or env("BENCH_SERVE_DISAGG", "") or None
+    if disagg and (replicas > 1 or sweep or draft):
+        raise SystemExit("--disagg is its own scenario: drop --replicas/"
+                         "--sweep/--serve-draft")
 
     mesh = with_backend_retry(meshlib.create_mesh)
     n = mesh.shape[meshlib.DATA_AXIS]
@@ -1437,15 +1451,16 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
         resolved_kv_dtype = ("int8" if kv_dtype == "int8"
                              else jnp.dtype(jnp.bfloat16))
     fleet_mode = bool(replicas and replicas > 1)
-    # fleet mode builds its own 2×N per-replica tables below and never
-    # dispatches these — skip the construction too (each table allocates
-    # the full slots×max_len KV buffers on device)
+    disagg_mode = bool(disagg)
+    # fleet/disagg modes build their own per-replica tables below and
+    # never dispatch these — skip the construction too (each table
+    # allocates the full slots×max_len KV buffers on device)
     kv = kv_base = kv_cmp = None
     # paged layout applies to the PRODUCTION tables only: kv_base stays
     # monolithic by construction — it IS the paged-vs-monolithic
     # comparison window on the same trace
     layout_kwargs = {"kv_layout": "paged"} if paged else {}
-    if not fleet_mode:
+    if not fleet_mode and not disagg_mode:
         kv = SlotKVCache(model, params, slots, mesh=mesh,
                          kv_dtype=resolved_kv_dtype,
                          prefix_cache_blocks=cache_blocks,
@@ -1575,9 +1590,9 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
         note(f"warm: production {kv.compiled_programs()}, "
              f"baseline {kv_base.compiled_programs()}")
 
-    if not fleet_mode:
-        # fleet mode warms its own per-replica tables below — the
-        # single-replica kv/kv_base/kv_cmp tables are not even built
+    if not fleet_mode and not disagg_mode:
+        # fleet/disagg modes warm their own per-replica tables below —
+        # the single-replica kv/kv_base/kv_cmp tables are not even built
         with_backend_retry(_warm, "first compile/warmup")
 
     tracer = Tracer(path=trace_path) if trace_path else NULL_TRACER
@@ -1625,6 +1640,282 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                  f"{summary['shed_requests']} shed")
             return summary
         return _one
+
+    if disagg_mode:
+        # ---------------------------------------- disagg scenario (round 18)
+        # Three same-trace comparisons on one line:
+        #   1. disaggregated P-prefill/D-decode fleet vs the homogeneous
+        #      (P+D)-replica fleet — decode replicas never share an
+        #      iteration with a long prompt, so the disagg ITL tail
+        #      should drop (disagg_vs_homogeneous_itl_p95/p99, < 1 =
+        #      disagg wins) with greedy tokens unchanged;
+        #   2. affinity vs least-loaded routing on the homogeneous fleet
+        #      — shared-prefix traffic lands where the pool is warm
+        #      (serve_fleet_prefix_hit_rate vs the least-loaded rate);
+        #   3. a diurnal quiet→burst→quiet trace where the autoscaled
+        #      fleet (1:N on queue depth) is compared against every
+        #      static size it scales between — goodput fraction of the
+        #      best static at the replica-seconds actually spent.
+        from distributed_tensorflow_tpu.serving import ReplicaSet
+        from distributed_tensorflow_tpu.utils.harness import (
+            parse_disaggregate)
+
+        n_prefill, n_decode = parse_disaggregate(disagg)
+        total = n_prefill + n_decode
+        roles = ["prefill"] * n_prefill + ["decode"] * n_decode
+
+        def mk_tables(spec_roles):
+            """One production-config table per entry of ``spec_roles``
+            (None = homogeneous, pool on).  Disagg decode tables carry no
+            prefix pool (they never prefill — pool warmth lives prefill-
+            side) but DO warm the handoff restore program; prefill
+            tables warm extract.  Same warm discipline as fleet mode:
+            every program a window can hit compiles here, outside the
+            timed windows."""
+            tables = []
+            lens = sorted({len(p) for p in prompts})
+            for role in spec_roles:
+                pool = 0 if role == "decode" else cache_blocks
+                t = SlotKVCache(model, params, slots, mesh=mesh,
+                                kv_dtype=resolved_kv_dtype,
+                                prefix_cache_blocks=pool,
+                                prefix_block=prefix_block,
+                                **layout_kwargs)
+                if chunk and role != "decode":
+                    buckets, b = [chunk], 1
+                    while b < chunk:
+                        buckets.append(b)
+                        b *= 2
+                    for blen in sorted(set(buckets)):
+                        slot, _ = t.begin_insert(
+                            rng.integers(0, vocab, blen).astype(np.int32))
+                        while t.prefill_chunk(slot, chunk) is None:
+                            pass
+                        t.advance()
+                        t.evict(slot)
+                for plen in lens:
+                    slot, _ = t.insert(prompts[
+                        [len(p) for p in prompts].index(plen)])
+                    t.advance()
+                    if role == "prefill":
+                        # prefill side serializes finished KV out —
+                        # warm the extract program at every length
+                        t.extract_handoff(slot)
+                    t.evict(slot)
+                if role == "decode":
+                    # decode side admits via restore only: warm it from
+                    # a throwaway extract at every prompt length
+                    for plen in lens:
+                        slot, _ = t.insert(prompts[
+                            [len(p) for p in prompts].index(plen)])
+                        payload = t.extract_handoff(slot)
+                        t.evict(slot)
+                        rslot, _ = t.restore_handoff(payload)
+                        t.advance()
+                        t.evict(rslot)
+                if pool:
+                    longest = max(prompts, key=len)
+                    for _ in range(2):
+                        slot, _ = t.insert(longest)
+                        t.advance()
+                        t.evict(slot)
+                    t.reset_prefix_cache()
+                tables.append(t)
+            return tables
+
+        homog_tables = with_backend_retry(
+            lambda: mk_tables([None] * total), "homogeneous tables")
+        disagg_tables = with_backend_retry(
+            lambda: mk_tables(roles), "disagg tables")
+
+        def diurnal_workload():
+            # one seeded quiet→burst→quiet trace (the diurnal shape
+            # autoscaling exists for): same prompts/lengths as the flat
+            # trace, arrivals re-drawn at [rate, 4×rate, rate]
+            rng_d = np.random.default_rng(7)
+            seg = max(n_requests // 3, 1)
+            t_arr, arr = 0.0, []
+            for k, r in enumerate((rate, 4.0 * rate, rate)):
+                count = (n_requests - 2 * seg) if k == 2 else seg
+                for _ in range(max(count, 0)):
+                    t_arr += rng_d.exponential(1.0 / max(r, 1e-9))
+                    arr.append(t_arr)
+            return [Request(rid=i, prompt=prompts[i],
+                            max_new_tokens=int(n_news[i]),
+                            arrival_s=float(arr[i]))
+                    for i in range(n_requests)]
+
+        def hetero_window(label, tables, *, w_roles=None,
+                          routing="least-loaded", autoscale=None,
+                          wl=None, sink=None):
+            def _one(rep):
+                for t in tables:
+                    if t.prefix_cache_blocks:
+                        t.reset_prefix_cache()
+                kwargs = {}
+                if w_roles is not None:
+                    kwargs["roles"] = w_roles
+                if routing != "least-loaded":
+                    kwargs["routing"] = routing
+                if autoscale is not None:
+                    kwargs["autoscale"] = autoscale
+                deliver = on_token
+                if sink is not None and rep == 0:
+                    deliver = (lambda rid, tok:
+                               sink.setdefault(rid, []).append(tok))
+                rs = ReplicaSet(tables, tracer=tracer,
+                                prefill_chunk=chunk, queue_cap=queue_cap,
+                                slo=SLOMonitor(slo_ttft, slo_itl),
+                                **kwargs)
+                t_w = time.perf_counter()
+                try:
+                    summary = serve_section(
+                        rs.run(wl() if wl else workload(),
+                               on_token=deliver), n)
+                finally:
+                    rs.close()
+                summary["window_elapsed_s"] = time.perf_counter() - t_w
+                note(f"{label} window {rep}: "
+                     f"{summary['completed']}/{summary['offered']} done, "
+                     f"itl_p95 {summary['serve_itl_p95_s'] * 1e3:.1f} ms, "
+                     f"goodput {summary['serve_goodput_under_slo']:.3f}/s")
+                return summary
+            return _one
+
+        homog_sink: dict[int, list] = {}
+        disagg_sink: dict[int, list] = {}
+        try:
+            homog = measure_windows(
+                hetero_window("homog", homog_tables, sink=homog_sink),
+                repeats, "homog", partial_errors)
+            if not homog:
+                raise RuntimeError(f"no homogeneous window completed: "
+                                   f"{partial_errors[-1]}")
+            dis = measure_windows(
+                hetero_window("disagg", disagg_tables, w_roles=roles,
+                              sink=disagg_sink),
+                repeats, "disagg", partial_errors)
+            if not dis:
+                raise RuntimeError(f"no disagg window completed: "
+                                   f"{partial_errors[-1]}")
+            aff = (measure_windows(
+                hetero_window("affinity", homog_tables,
+                              routing="affinity"),
+                1, "affinity", partial_errors) if cache_blocks else [])
+            auto = measure_windows(
+                hetero_window("diurnal_autoscale", homog_tables,
+                              autoscale=f"1:{total}",
+                              wl=diurnal_workload),
+                1, "diurnal_autoscale", partial_errors)
+            statics = []
+            for n_static in sorted({1, total}):
+                w = measure_windows(
+                    hetero_window(f"diurnal_static{n_static}",
+                                  homog_tables[:n_static],
+                                  wl=diurnal_workload),
+                    1, f"diurnal_static{n_static}", partial_errors)
+                if w:
+                    statics.append((n_static, w[0]))
+        finally:
+            tracer.close()
+
+        h95 = med(homog, "serve_itl_p95_s")
+        h99 = med(homog, "serve_itl_p99_s")
+        d95 = med(dis, "serve_itl_p95_s")
+        d99 = med(dis, "serve_itl_p99_s")
+        parity = (sorted(homog_sink) == sorted(disagg_sink)
+                  and all(homog_sink[r] == disagg_sink[r]
+                          for r in homog_sink))
+        aff_rate = (aff[0].get("serve_fleet_prefix_hit_rate")
+                    if aff else None)
+        ll_rate = med(homog, "serve_prefix_cache_hit_rate")
+        auto_w = auto[0] if auto else None
+        best_static = max(statics, key=lambda s:
+                          s[1].get("serve_goodput_under_slo") or 0.0,
+                          default=None)
+        frac = None
+        if auto_w and best_static:
+            bg = best_static[1].get("serve_goodput_under_slo") or 0.0
+            ag = auto_w.get("serve_goodput_under_slo") or 0.0
+            frac = round(ag / bg, 4) if bg else None
+        print(json.dumps({
+            "metric": "gpt_serve_disagg_itl_p95_ratio",
+            "value": (round(d95 / h95, 3) if d95 and h95 else None),
+            "unit": "disagg/homogeneous itl_p95 ratio (< 1 = disagg wins)",
+            "vs_baseline": None,
+            "method": (f"{n_prefill}P+{n_decode}D disaggregated fleet "
+                       f"(KV handoff) vs {total} homogeneous replicas "
+                       f"on the SAME seeded Poisson trace ({rate}/s × "
+                       f"{n_requests}, long prompt every {long_every}), "
+                       f"median of {len(dis)}/{len(homog)}; affinity "
+                       f"router vs least-loaded on the same trace; "
+                       f"diurnal quiet/4×burst/quiet trace: autoscaled "
+                       f"1:{total} vs static sizes"),
+            # the three `analyze diff` gate keys (ISSUE 18)
+            "disagg_vs_homogeneous_itl_p95": (
+                round(d95 / h95, 3) if d95 and h95 else None),
+            "disagg_vs_homogeneous_itl_p99": (
+                round(d99 / h99, 3) if d99 and h99 else None),
+            "serve_fleet_prefix_hit_rate": aff_rate,
+            "serve_replica_seconds": (
+                auto_w.get("serve_replica_seconds") if auto_w else None),
+            "greedy_tokens_match": parity,
+            "least_loaded_prefix_hit_rate": ll_rate,
+            "affinity_beats_least_loaded": (
+                aff_rate > ll_rate
+                if aff_rate is not None and ll_rate is not None
+                else None),
+            "autoscale_goodput_fraction_of_best_static": frac,
+            "best_static_replicas": (best_static[0]
+                                     if best_static else None),
+            "best_static_goodput": (
+                best_static[1].get("serve_goodput_under_slo")
+                if best_static else None),
+            "static_replica_seconds": {
+                str(ns): round(ns * w["window_elapsed_s"], 3)
+                for ns, w in statics},
+            "autoscale": auto_w.get("autoscale") if auto_w else None,
+            "serve_disagg": dis[0].get("serve_disagg"),
+            "homogeneous": {k: med(homog, k) for k in (
+                "serve_requests_per_sec_per_chip", "serve_ttft_p95_s",
+                "serve_itl_p95_s", "serve_itl_p99_s",
+                "serve_goodput_under_slo")},
+            "disagg": {k: med(dis, k) for k in (
+                "serve_requests_per_sec_per_chip", "serve_ttft_p95_s",
+                "serve_itl_p95_s", "serve_itl_p99_s",
+                "serve_goodput_under_slo")},
+            "slo": {"ttft_s": slo_ttft, "itl_s": slo_itl,
+                    "quantile": 0.99},
+            "config": {"disaggregate": disagg,
+                       "prefill_replicas": n_prefill,
+                       "decode_replicas": n_decode,
+                       "slots_per_replica": slots,
+                       "requests": n_requests,
+                       "arrival_rate_per_s": rate,
+                       "prompt_len": prompt_len,
+                       "max_new_tokens": max_new, "vocab": vocab,
+                       "hidden": hidden, "layers": layers,
+                       "heads": heads, "ffn": ffn, "max_len": max_len,
+                       "dtype": "bfloat16", "greedy": True,
+                       "prefill_chunk": chunk,
+                       "prefix_cache_blocks": cache_blocks,
+                       "prefix_block": prefix_block,
+                       "shared_prefix": shared_len,
+                       "long_every": long_every,
+                       "kv_dtype": homog_tables[0].kv_dtype,
+                       "kv_layout": kv_layout},
+            "device": device_kind,
+            "n_devices": n,
+            "synthetic": True,
+            "jax_version": jax.__version__,
+            "xla_flags": os.environ.get("XLA_FLAGS"),
+            "libtpu_init_args": os.environ.get("LIBTPU_INIT_ARGS"),
+            **({"partial": {"errors": partial_errors,
+                            "homog_windows": len(homog),
+                            "disagg_windows": len(dis)}}
+               if partial_errors else {}),
+        }))
+        return
 
     if fleet_mode:
         # ------------------------------------------- fleet mode (round 15)
@@ -2181,6 +2472,7 @@ _MODE_METRICS = {
     "serve": "gpt_serve_requests_per_sec_per_chip",
     "serve_sweep": "gpt_serve_max_goodput_under_slo",
     "serve_fleet": "gpt_serve_fleet_requests_per_sec_per_chip",
+    "serve_disagg": "gpt_serve_disagg_itl_p95_ratio",
     "default": "mnist_cnn_sync_examples_per_sec_per_chip",
 }
 
@@ -2280,6 +2572,18 @@ def main() -> None:
                         "p95_s, serve_duplicate_emissions and the "
                         "exactly-once conservation check (default "
                         "BENCH_SERVE_REPLICAS or off)")
+    p.add_argument("--disagg", default=None, metavar="P:D",
+                   help="--serve: disaggregated-fleet scenario line "
+                        "(round 18) — a P-prefill/D-decode fleet with "
+                        "serialized KV handoff vs the homogeneous "
+                        "(P+D)-replica fleet on the SAME seeded trace "
+                        "(disagg_vs_homogeneous_itl_p95/p99 + greedy "
+                        "parity), affinity vs least-loaded routing "
+                        "(serve_fleet_prefix_hit_rate), and a diurnal "
+                        "burst trace comparing the 1:(P+D) autoscaled "
+                        "fleet against its static sizes "
+                        "(serve_replica_seconds + goodput fraction of "
+                        "the best static); default BENCH_SERVE_DISAGG")
     p.add_argument("--steps", type=int, default=100,
                    help="--stream: measured steps per repetition (the test "
                         "suite's smoke invocation shrinks this, plus "
@@ -2350,7 +2654,10 @@ def main() -> None:
             else "decode" if args.decode else "default")
     fleet_n = args.replicas or int(os.environ.get("BENCH_SERVE_REPLICAS",
                                                   "0"))
-    metric = (_MODE_METRICS["serve_sweep"]
+    disagg_spec = args.disagg or os.environ.get("BENCH_SERVE_DISAGG", "")
+    metric = (_MODE_METRICS["serve_disagg"]
+              if mode == "serve" and disagg_spec
+              else _MODE_METRICS["serve_sweep"]
               if mode == "serve" and args.sweep
               else _MODE_METRICS["serve_fleet"]
               if mode == "serve" and fleet_n > 1 else _MODE_METRICS[mode])
@@ -2366,7 +2673,8 @@ def main() -> None:
                         draft=args.serve_draft,
                         draft_k=args.serve_draft_k,
                         replicas=args.replicas,
-                        kv_layout=args.serve_kv_layout)
+                        kv_layout=args.serve_kv_layout,
+                        disagg=args.disagg)
         elif mode == "stream":
             bench_stream(steps=max(args.steps, 1),
                          grad_compression=args.grad_compression,

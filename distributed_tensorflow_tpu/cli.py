@@ -353,6 +353,44 @@ def build_parser() -> argparse.ArgumentParser:
                         "proves it.  The drill re-installs the same "
                         "trained params so greedy tokens are unchanged; "
                         "a real rollout passes a new checkpoint")
+    p.add_argument("--serve-disaggregate", default=None, metavar="P:D",
+                   help="--serve: disaggregated prefill/decode fleet — "
+                        "P prefill replicas (admission + chunked "
+                        "prefill only) hand finished KV to D decode "
+                        "replicas via serialized-block transfer "
+                        "(extract_handoff/restore_handoff; works for "
+                        "monolithic and paged layouts, int8 scales "
+                        "ride along), so decode replicas never share "
+                        "an iteration with a long prompt.  Overrides "
+                        "--serve-replicas with P+D; the prefix pool "
+                        "stays prefill-side.  TTFT is still charged "
+                        "arrival -> first token INCLUDING the handoff. "
+                        "The serve section gains serve_disagg (handoff "
+                        "+ per-role conservation counters)")
+    p.add_argument("--serve-routing", default="least-loaded",
+                   choices=("least-loaded", "affinity"),
+                   help="--serve: fleet router policy.  'affinity' "
+                        "keys each request on its first prefix-block "
+                        "digest (the prefix pool's chained SHA-256 "
+                        "keys) and routes repeats to the replica whose "
+                        "pool is already warm, falling back to least-"
+                        "loaded for new/short prompts; the serve "
+                        "section gains serve_fleet_prefix_hit_rate "
+                        "(needs --serve-prefix-cache > 0).  Default "
+                        "'least-loaded' is the round-17 router, "
+                        "byte-identical")
+    p.add_argument("--serve-autoscale", default=None, metavar="MIN:MAX",
+                   help="--serve: queue-driven autoscaling — the fleet "
+                        "starts MIN serving replicas (the rest of "
+                        "--serve-replicas dormant: KV allocated, no "
+                        "requests routed) and wakes one when arrived "
+                        "queue depth crosses the high-watermark, "
+                        "draining one back down when idle.  MAX caps "
+                        "serving replicas (0 = fleet size); MAX must "
+                        "fit inside --serve-replicas.  The serve "
+                        "section gains autoscale (scale events) + "
+                        "serve_replica_seconds, the efficiency ledger "
+                        "`analyze diff` gates lower-is-better")
     p.add_argument("--model-arg", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="extra model constructor field (repeatable), e.g. "
@@ -721,6 +759,9 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         serve_kv_layout=args.serve_kv_layout,
         serve_paged_block=args.serve_paged_block,
         serve_paged_blocks=args.serve_paged_blocks,
+        serve_disaggregate=args.serve_disaggregate,
+        serve_routing=args.serve_routing,
+        serve_autoscale=args.serve_autoscale,
     )
     summary = run(config)  # run() itself wraps recovery when max_restarts>0
     print(json.dumps(summary))

@@ -700,6 +700,19 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     # offered load, growth is an admission/capacity regression
     ("queue_depth_auc", "lower"),
     ("kv_blocks_in_use_p95", "lower"),
+    # heterogeneous fleet (round 18; BASELINE.md "Disaggregation
+    # accounting"): the affinity router's fleet-wide prefix hit rate is
+    # the number the router exists to raise (fewer hits at the same
+    # trace = shared-prefix traffic landing on cold pools); replica-
+    # seconds is the capacity actually paid for the window — the
+    # autoscaler's whole point is to shrink it at held goodput; and the
+    # disagg/homogeneous ITL-p95 ratio on the same seeded trace is the
+    # decode-interference number disaggregation exists to shrink (< 1 =
+    # disagg wins, growth = the handoff is leaking prefill work back
+    # into decode iterations).
+    ("serve_fleet_prefix_hit_rate", "higher"),
+    ("serve_replica_seconds", "lower"),
+    ("disagg_vs_homogeneous_itl_p95", "lower"),
 )
 
 
@@ -791,7 +804,11 @@ def _value_direction(report: dict[str, Any]) -> str:
                                 "sec_per", "s/step", "latency",
                                 # byte-valued headlines (kv_bytes_per_slot
                                 # class): smaller footprint is the win
-                                "byte")):
+                                "byte",
+                                # latency-ratio headlines (the round-18
+                                # disagg line: disagg/homogeneous itl_p95,
+                                # < 1 = disagg wins): ITL is a latency
+                                "itl")):
         return "lower"
     return "higher"
 

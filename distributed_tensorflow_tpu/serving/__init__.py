@@ -29,7 +29,17 @@ Three layers:
   re-prefills prompt + emitted prefix, greedy-exact), seeded fault
   injection (``FaultInjector``), and graceful drain + zero-downtime
   weight hot-swap (``SlotKVCache.swap_params``) that never drops the
-  fleet below N−1 admitting replicas.
+  fleet below N−1 admitting replicas.  Round 18 makes the fleet
+  heterogeneous, all default-off: ``roles`` disaggregates prefill from
+  decode with a serialized KV handoff
+  (``SlotKVCache.extract_handoff``/``restore_handoff``), so decode
+  replicas never share an iteration with a long prompt;
+  ``routing="affinity"`` lands shared-prefix traffic where its first
+  prefix block is already warm; ``autoscale`` (``AutoscalePolicy``)
+  drives the serving-replica count from arrived queue depth with
+  ``serve_replica_seconds`` as the efficiency ledger; and
+  ``parallel_lanes`` gives each replica its own virtual-time lane so
+  fleet time overlaps replicas deterministically.
 
 ``bench.py --serve`` drives an open-loop arrival process through both and
 reports requests/sec/chip + latency percentiles; the harness's ``--serve``
@@ -38,8 +48,8 @@ report, gated by ``analyze diff`` exactly like the training metrics.
 """
 
 from distributed_tensorflow_tpu.serving.fleet import (  # noqa: F401
-    CorruptionDetected, FaultInjector, FaultSpec, InjectedFault,
-    ReplicaSet, RequestJournal, build_replica_kvs)
+    AutoscalePolicy, CorruptionDetected, FaultInjector, FaultSpec,
+    InjectedFault, ReplicaSet, RequestJournal, build_replica_kvs)
 from distributed_tensorflow_tpu.serving.kv_cache import (  # noqa: F401
     BlockPoolExhausted, PagedSlotKVCache, SlotKVCache, SlotOverflow)
 from distributed_tensorflow_tpu.serving.scheduler import (  # noqa: F401

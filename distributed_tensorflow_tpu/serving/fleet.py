@@ -55,6 +55,7 @@ is that difference, with robustness as the headline contract:
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import threading
 import time
 from typing import Any, Callable, Iterable
@@ -87,7 +88,7 @@ class CorruptionDetected(RuntimeError):
 # ------------------------------------------------------------ fault specs
 
 _FAULT_KINDS = ("crash", "stall", "nanlogits")
-_FAULT_SITES = ("decode", "prefill", "verify")
+_FAULT_SITES = ("decode", "prefill", "verify", "handoff")
 
 
 @dataclasses.dataclass
@@ -142,6 +143,9 @@ class FaultInjector:
     program — the kill-during-prefill-chunk case),
     ``crash:replica=0,verify=1`` (AFTER the verify step computed, BEFORE
     any commit — the kill-between-verify-and-commit case),
+    ``crash:replica=0,handoff=1`` (a disaggregated prefill replica killed
+    between prefill completion and decode admission — inside the KV
+    extract, before the payload leaves the replica),
     ``stall:replica=1,iter=2,stall_s=0.5``, ``nanlogits:replica=0,iter=4``,
     ``crash:replica=0,prob=0.05`` (seeded Bernoulli per iteration).
 
@@ -195,6 +199,8 @@ class FaultInjector:
                         kw["site"], kw["at"] = "prefill", int(val)
                     elif key == "verify":
                         kw["site"], kw["at"] = "verify", int(val)
+                    elif key == "handoff":
+                        kw["site"], kw["at"] = "handoff", int(val)
                     elif key == "prob":
                         kw["prob"] = float(val)
                     elif key == "stall_s":
@@ -202,7 +208,8 @@ class FaultInjector:
                     else:
                         raise ValueError(
                             f"unknown --serve-fault-spec key '{key}' "
-                            f"(replica/iter/prefill/verify/prob/stall_s)")
+                            f"(replica/iter/prefill/verify/handoff/prob/"
+                            f"stall_s)")
                 except ValueError as e:
                     if "fault-spec" in str(e):
                         raise
@@ -241,13 +248,14 @@ class FaultInjector:
         wrappers: the class and every other table stay untouched."""
         if not any(s.replica == replica_id for s in self.specs):
             return
-        counts = {"decode": 0, "prefill": 0, "verify": 0}
+        counts = {"decode": 0, "prefill": 0, "verify": 0, "handoff": 0}
         injector = self
 
         orig_advance = kv.advance
         orig_insert = kv.insert
         orig_chunk = kv.prefill_chunk
         orig_verify = kv.verify_block
+        orig_extract = kv.extract_handoff
 
         def advance(only=None):
             if only is None:   # draft catch-up steps are not iterations
@@ -317,10 +325,24 @@ class FaultInjector:
                 g[:] = -1
             return g
 
+        def extract_handoff(slot):
+            # fires BEFORE the KV leaves the replica: prefill is complete,
+            # decode admission has not happened — the batcher's handoff
+            # guard evicts the slot, so the crash must not leak blocks
+            counts["handoff"] += 1
+            s = injector._check(replica_id, "handoff", counts["handoff"])
+            if s is not None:
+                raise InjectedFault(
+                    f"injected crash: replica {replica_id} handoff "
+                    f"{counts['handoff']} (between prefill completion and "
+                    f"decode admission)")
+            return orig_extract(slot)
+
         kv.advance = advance
         kv.insert = insert
         kv.prefill_chunk = prefill_chunk
         kv.verify_block = verify_block
+        kv.extract_handoff = extract_handoff
 
 
 # --------------------------------------------------------------- journal
@@ -334,6 +356,11 @@ class _Entry:
     status: str = "pending"   # pending | done | shed | lost | unserved
     replica: int | None = None
     attempts: int = 0
+    phase: str = "prefill"    # disagg role the request currently sits in:
+    #                           "prefill" until its KV is handed off, then
+    #                           "decode"; a requeue flips it back (resume
+    #                           re-prefills).  Homogeneous fleets never
+    #                           leave "prefill".
     emitted: list[int] = dataclasses.field(default_factory=list)
     emit_t: list[float] = dataclasses.field(default_factory=list)
     assigned_t: float = 0.0
@@ -381,13 +408,18 @@ class RequestJournal:
 
     # ------------------------------------------------------------ routing
     def assign(self, rid: int, replica: int, t: float,
-               retry: bool = False) -> None:
+               retry: bool = False, transfer: bool = False) -> None:
+        """``transfer`` moves a live assignment between replicas without
+        consuming retry budget — a KV handoff (prefill → decode) or an
+        autoscale rebalance is a routing event, not a failure."""
         with self._lock:
             e = self.entries[rid]
             if e.replica is not None:
                 self.load[e.replica] = self.load.get(e.replica, 1) - 1
             e.replica = replica
-            e.attempts += 1
+            if not transfer:
+                e.attempts += 1
+                e.phase = "prefill"   # fresh/retried work re-prefills
             e.assigned_t = t
             if e.first_assigned_t is None:
                 e.first_assigned_t = t
@@ -395,6 +427,10 @@ class RequestJournal:
             if retry:
                 self.requeues += 1
                 self.requeued_rids.add(rid)
+
+    def set_phase(self, rid: int, phase: str) -> None:
+        with self._lock:
+            self.entries[rid].phase = phase
 
     def least_loaded(self, replicas: Iterable[int]) -> int:
         """Front-end routing: the serving replica with the fewest live
@@ -529,6 +565,19 @@ class RequestJournal:
                 c[e.status] += 1
             return c
 
+    def role_counts(self) -> dict[str, dict[str, int]]:
+        """Terminal status counts partitioned by the phase each request
+        ENDED in.  Phase is single-valued, so the two partitions sum to
+        ``counts()`` exactly — a dropped handoff flips the request back
+        to "prefill" and it is counted once, there; it cannot
+        double-count or vanish."""
+        with self._lock:
+            out = {p: {"done": 0, "shed": 0, "lost": 0, "unserved": 0,
+                       "pending": 0} for p in ("prefill", "decode")}
+            for e in self.entries.values():
+                out[e.phase][e.status] += 1
+            return out
+
     def results(self) -> list[RequestResult]:
         """Fleet-level per-request results from the journal's emission
         timeline: TTFT from the ORIGINAL arrival (retries do not reset
@@ -650,15 +699,76 @@ class _Replica:
         self.registry = registry
         self.lease = LeaseManager(signals=())   # trigger()-driven only
         self.queue = _FleetQueue()
-        self.state = "serving"                  # serving | failed
+        self.state = "serving"                  # serving | dormant | failed
         self.generation = 0                     # weight-swap count
         self.busy = False
         self.completed = 0
+        self.role: str | None = None            # prefill | decode | None
+        self.serve_start: float | None = None   # replica_seconds interval
+        self.idle_since: float | None = None    # autoscale scale-down timer
         self.failure: str | None = None
         self.last_progress = time.monotonic()
         self.work = threading.Event()
         self.stop = threading.Event()
         self.thread: threading.Thread | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """Queue-driven replica-count policy (``--serve-autoscale MIN:MAX``).
+
+    Scale-up fires when the fleet's ARRIVED backlog per admitting
+    replica crosses ``high_watermark`` — queue depth is the leading
+    overload signal (the PR 11 finding: depth p95 climbs before goodput
+    falls), so capacity is added before the knee, not after shed rate
+    proves it arrived too late.  Scale-down retires one replica with no
+    arrived work after ``idle_s`` of continuous idleness, transferring
+    its not-yet-arrived assignments to the survivors.  ``cooldown_s``
+    spaces consecutive scaling actions so one burst cannot thrash the
+    fleet, and ``slice_s`` bounds each replica's serving slice so the
+    supervisor gets a decision point at least that often in fleet time
+    (without it a sequential replica would serve its whole queue —
+    including idle gaps — before the policy could react).
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 0          # 0 = every replica in the set
+    high_watermark: float = 4.0    # arrived backlog per admitting replica
+    idle_s: float = 2.0            # continuous idleness before scale-down
+    cooldown_s: float = 1.0        # min spacing between scaling actions
+    slice_s: float = 4.0           # max serving slice between decisions
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"autoscale min_replicas must be >= 1, "
+                f"got {self.min_replicas}")
+        if self.max_replicas and self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"autoscale max_replicas ({self.max_replicas}) must be >= "
+                f"min_replicas ({self.min_replicas})")
+        if self.high_watermark <= 0:
+            raise ValueError(
+                f"autoscale high_watermark must be > 0, "
+                f"got {self.high_watermark}")
+        if self.idle_s < 0 or self.cooldown_s < 0 or self.slice_s <= 0:
+            raise ValueError(
+                "autoscale idle_s/cooldown_s must be >= 0 and "
+                "slice_s > 0")
+
+    @staticmethod
+    def parse(spec: str) -> "AutoscalePolicy":
+        """``--serve-autoscale MIN:MAX`` grammar (e.g. ``1:4``)."""
+        lo, colon, hi = spec.partition(":")
+        try:
+            if not colon:
+                raise TypeError
+            lo_i, hi_i = int(lo), int(hi)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"--serve-autoscale must be MIN:MAX (e.g. 1:4), "
+                f"got '{spec}'") from None
+        return AutoscalePolicy(min_replicas=lo_i, max_replicas=hi_i)
 
 
 class ReplicaSet:
@@ -687,6 +797,31 @@ class ReplicaSet:
     beyond the first), with ``retry_backoff_s`` exponential arrival
     backoff; an exhausted request is terminal ``lost`` and counts into
     ``unserved_requests`` (conservation stays exact).
+
+    Round 18 (all default-off — the defaults are class-, program- and
+    summary-key-identical to the homogeneous fleet):
+
+    - ``roles`` disaggregates the fleet (one ``"prefill"``/``"decode"``
+      entry per replica): prefill replicas run admission + chunked
+      prefill only and hand the finished KV to a decode replica as a
+      serialized block payload (``SlotKVCache.extract_handoff``), taking
+      ``handoff_s`` of simulated transfer time that lands inside the
+      request's TTFT; decode replicas never share an iteration with a
+      long prompt.  Retries re-prefill, so they route to the prefill
+      side.
+    - ``routing="affinity"`` keys fresh requests on the chained SHA-256
+      digest of their first prefix block and lands shared-prefix
+      traffic where that block is already resident (falling back to
+      least-loaded for unkeyed prompts and retries).
+    - ``autoscale`` (an :class:`AutoscalePolicy` or ``"MIN:MAX"``)
+      drives the serving-replica count from arrived queue depth;
+      replicas above the floor start dormant and ``replica_seconds``
+      (integral of serving time) lands in the summary.
+    - ``parallel_lanes`` (VirtualClock, sequential driver) gives each
+      replica its own virtual-time lane so N replicas genuinely overlap
+      in fleet time — cross-replica events (handoffs, retries) carry
+      absolute stamps and the receiving lane jumps forward, never back.
+      Fleet elapsed time is then the max over lanes.
     """
 
     def __init__(self, kvs: list[SlotKVCache], *, tracer=NULL_TRACER,
@@ -697,7 +832,12 @@ class ReplicaSet:
                  retry_backoff_s: float = 0.0,
                  watchdog_timeout_s: float = 0.0,
                  fault_injector: FaultInjector | None = None,
-                 timeline=None):
+                 timeline=None,
+                 roles: list[str] | None = None,
+                 routing: str = "least-loaded",
+                 autoscale: AutoscalePolicy | str | None = None,
+                 handoff_s: float = 0.0,
+                 parallel_lanes: bool = False):
         if not kvs:
             raise ValueError("ReplicaSet needs at least one SlotKVCache")
         if draft_kvs is not None and len(draft_kvs) != len(kvs):
@@ -706,12 +846,63 @@ class ReplicaSet:
                 f"drafts vs {len(kvs)} replicas)")
         if retry_limit < 0:
             raise ValueError(f"retry_limit must be >= 0, got {retry_limit}")
+        if routing not in ("least-loaded", "affinity"):
+            raise ValueError(
+                f"routing must be 'least-loaded' or 'affinity', "
+                f"got '{routing}'")
+        if roles is not None:
+            roles = [str(r) for r in roles]
+            if len(roles) != len(kvs):
+                raise ValueError(
+                    f"roles must pair replicas 1:1 ({len(roles)} roles "
+                    f"vs {len(kvs)} replicas)")
+            bad = sorted(set(roles) - {"prefill", "decode"})
+            if bad:
+                raise ValueError(
+                    f"roles must be 'prefill' or 'decode', got {bad}")
+            if "prefill" not in roles or "decode" not in roles:
+                raise ValueError(
+                    "a disaggregated fleet needs at least one prefill "
+                    "AND one decode replica")
+            if draft_kvs is not None:
+                raise ValueError(
+                    "speculative decoding is not supported in a "
+                    "disaggregated fleet (draft KV state does not ride "
+                    "the handoff payload)")
+        if isinstance(autoscale, str):
+            autoscale = AutoscalePolicy.parse(autoscale)
+        if autoscale is not None:
+            if roles is not None:
+                raise ValueError(
+                    "autoscale drives a homogeneous fleet; combining it "
+                    "with roles (disaggregation) is not supported")
+            n_max = autoscale.max_replicas or len(kvs)
+            if not autoscale.min_replicas <= n_max <= len(kvs):
+                raise ValueError(
+                    f"autoscale range {autoscale.min_replicas}:{n_max} "
+                    f"must fit in the {len(kvs)}-replica set")
+        if handoff_s < 0:
+            raise ValueError(f"handoff_s must be >= 0, got {handoff_s}")
         self.tracer = tracer
         base_clock = clock if clock is not None else WallClock()
         self.clock = _SharedClock(base_clock)
         if threaded is None:
             threaded = not isinstance(base_clock, VirtualClock)
         self.threaded = bool(threaded)
+        if parallel_lanes:
+            if not isinstance(base_clock, VirtualClock):
+                raise ValueError(
+                    "parallel_lanes needs a VirtualClock base (wall time "
+                    "already overlaps replicas via threads)")
+            if self.threaded:
+                raise ValueError(
+                    "parallel_lanes is a sequential-driver feature "
+                    "(threaded=False)")
+        self.roles = roles
+        self.routing = routing
+        self.autoscale = autoscale
+        self.handoff_s = float(handoff_s)
+        self.parallel_lanes = bool(parallel_lanes)
         self.slo = slo
         self.retry_limit = int(retry_limit)
         self.retry_backoff_s = float(retry_backoff_s)
@@ -725,20 +916,38 @@ class ReplicaSet:
         self.timeline = timeline
         self.vocab = int(kvs[0].dm.vocab_size)
         self.draft_kvs = draft_kvs
+        self._affinity_block = int(getattr(kvs[0], "prefix_block", 0) or 0)
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
+        self._lanes: dict[int, _SharedClock] = {}
         self.replicas: list[_Replica] = []
         for i, kv in enumerate(kvs):
             registry = MetricsRegistry()
             replica = _Replica(i, kv, registry)
+            role = None if roles is None else roles[i]
+            replica.role = role
+            rclock = self.clock
+            if parallel_lanes:
+                # each replica advances its own virtual lane; all lanes
+                # share the epoch (start() zeroes them together in run())
+                rclock = _SharedClock(VirtualClock(
+                    tick=base_clock.tick,
+                    prefill_token_tick=base_clock.prefill_token_tick))
+                self._lanes[i] = rclock
             replica.batcher = ContinuousBatcher(
-                kv, tracer=tracer, clock=self.clock, mode="continuous",
-                prefill_chunk=prefill_chunk, metrics=registry,
-                queue_cap=queue_cap,
+                kv, tracer=tracer, clock=rclock, mode="continuous",
+                # decode replicas restore handed-off KV instead of
+                # prefilling, and never shed (a handoff is admitted work)
+                prefill_chunk=(0 if role == "decode" else prefill_chunk),
+                metrics=registry,
+                queue_cap=(0 if role == "decode" else queue_cap),
                 should_stop=(lambda iters, r=replica:
                              self._replica_should_stop(r, iters)),
                 draft_kv=(draft_kvs[i] if draft_kvs is not None else None),
-                draft_k=draft_k, timeline=timeline, timeline_tag=i)
+                draft_k=draft_k, timeline=timeline, timeline_tag=i,
+                role=role,
+                handoff_out=(self._handoff_hook(replica)
+                             if role == "prefill" else None))
             self.replicas.append(replica)
             if fault_injector is not None:
                 fault_injector.arm(i, kv)
@@ -765,9 +974,34 @@ class ReplicaSet:
         self._phase_sums: dict[str, float] = {}
         self._shed_count = 0
         self._run_summaries = 0
+        # round-18 per-run ledgers (all identically zero/empty flag-off)
+        self._affinity: dict[bytes, int] = {}
+        self._handoffs_initiated = 0
+        self._handoffs_delivered = 0
+        self._handoffs_dropped = 0
+        self._replica_seconds = 0.0
+        self._scale_ups = 0
+        self._scale_downs = 0
+        self._scale_events: list[dict[str, Any]] = []
+        self._last_scale_t: float | None = None
+        self._slice_end: dict[int, float] = {}
+        self._t_start = 0.0
+        self._run_live = False
 
     def _serving(self) -> list[_Replica]:
         return [r for r in self.replicas if r.state == "serving"]
+
+    def _clock_for(self, replica: _Replica):
+        """The clock a replica's events are stamped with: its own lane
+        under ``parallel_lanes``, the shared fleet clock otherwise."""
+        return self._lanes.get(replica.id, self.clock)
+
+    def _fleet_now(self) -> float:
+        """Fleet time: max over replica lanes (a lane only ever jumps
+        forward, so the max is monotone), or the shared clock."""
+        if self._lanes:
+            return max(lane.now() for lane in self._lanes.values())
+        return self.clock.now()
 
     def _note_admitting(self) -> None:
         """Track the fleet's minimum admitting-replica count (serving and
@@ -791,11 +1025,22 @@ class ReplicaSet:
                     if r.state == "serving" else 0)
             tl.sample("replica_load", load, replica=r.id)
         counts = self.journal.counts()
-        tl.sample_many({
+        gauges = {
             "admitting_replicas": len(self._serving()) - self._draining,
             "journal_pending": counts.get("pending", 0),
             "journal_retries": self.journal.requeues,
-        }, group="fleet")
+        }
+        if self.roles is not None:
+            # per-role load: where the fleet's live assignments sit —
+            # the disaggregation dashboards' headline gauge pair
+            for role in ("prefill", "decode"):
+                gauges[f"{role}_load"] = sum(
+                    self.journal.load.get(r.id, 0)
+                    for r in self.replicas
+                    if r.role == role and r.state == "serving")
+        if self.autoscale is not None:
+            gauges["serving_replicas"] = len(self._serving())
+        tl.sample_many(gauges, group="fleet")
 
     def _replica_should_stop(self, replica: _Replica,
                              iters: int) -> str | None:
@@ -806,20 +1051,69 @@ class ReplicaSet:
         program (or an injected stall) freezes — exactly the distinction
         `busy` alone cannot make."""
         replica.last_progress = time.monotonic()
-        return replica.lease.should_stop(iters)
+        reason = replica.lease.should_stop(iters)
+        if reason is not None:
+            return reason
+        if self.autoscale is not None:
+            end = self._slice_end.get(replica.id)
+            if end is not None and self._clock_for(replica).now() >= end:
+                # bounded serving slice: drain in-flight work and hand
+                # control back so the autoscaler gets a decision point
+                return "autoscale_slice"
+        return None
 
     # ------------------------------------------------------------ routing
+    def _route_candidates(self) -> list[_Replica]:
+        """Replicas a fresh (or retried) request may land on: the whole
+        serving set — or, disaggregated, the prefill side only (a resume
+        re-prefills, so retries go there too)."""
+        serving = self._serving()
+        if self.roles is None:
+            return serving
+        return [r for r in serving if r.role == "prefill"]
+
+    def _affinity_key(self, prompt) -> bytes | None:
+        """The chained SHA-256 digest of the prompt's FIRST prefix block
+        — the same key the prefix pool stores for that block, so routing
+        on it lands a request where its shared prefix is already warm.
+        None for prompts shorter than one block (nothing shareable to
+        key on)."""
+        blk = self._affinity_block
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        if blk <= 0 or p.shape[0] < blk:
+            return None
+        h = hashlib.sha256(b"")
+        h.update(p[:blk].tobytes())
+        return h.digest()
+
     def _route(self, req: Request, retry: bool = False,
                from_replica: int | None = None,
-               reason: str | None = None) -> bool:
-        """Assign ``req`` to the least-loaded serving replica; False when
-        no replica can take it (the caller marks it lost)."""
-        serving = self._serving()
-        if not serving:
+               reason: str | None = None,
+               at: float | None = None) -> bool:
+        """Assign ``req`` among the route candidates — prefix-affinity
+        first when enabled (a fresh request with a keyable first block
+        follows earlier traffic with the same block), least-loaded
+        otherwise; False when no replica can take it (the caller marks
+        it lost)."""
+        candidates = self._route_candidates()
+        if not candidates:
             return False
-        target = self.replicas[self.journal.least_loaded(
-            [r.id for r in serving])]
-        now = self.clock.now()
+        target = None
+        if self.routing == "affinity" and not retry:
+            key = self._affinity_key(req.prompt)
+            if key is not None:
+                by_id = {r.id: r for r in candidates}
+                known = self._affinity.get(key)
+                if known is not None and known in by_id:
+                    target = by_id[known]
+                else:
+                    target = self.replicas[self.journal.least_loaded(
+                        list(by_id))]
+                    self._affinity[key] = target.id
+        if target is None:
+            target = self.replicas[self.journal.least_loaded(
+                [r.id for r in candidates])]
+        now = self.clock.now() if at is None else float(at)
         self.journal.assign(req.rid, target.id, now, retry=retry)
         if retry:
             entry = self.journal.entries[req.rid]
@@ -850,7 +1144,7 @@ class ReplicaSet:
                     f"[0, {self.vocab}) for rid {rid} — nonfinite-logits "
                     f"corruption")
             accepted, done, _recovery = self.journal.emit(
-                rid, replica.id, tok, self.clock.now())
+                rid, replica.id, tok, self._clock_for(replica).now())
             replica.last_progress = time.monotonic()
             if not accepted:
                 return   # fenced: counted by the journal, never delivered
@@ -872,7 +1166,10 @@ class ReplicaSet:
             replica.state = "failed"
             replica.failure = f"{type(exc).__name__}: {exc}"
             self._note_admitting()
-            now = self.clock.now()
+            now = self._clock_for(replica).now()
+            if replica.serve_start is not None:
+                self._replica_seconds += max(now - replica.serve_start, 0.0)
+                replica.serve_start = None
             kind = kind or (
                 "injected" if isinstance(exc, InjectedFault) else
                 "corruption" if isinstance(exc, CorruptionDetected) else
@@ -899,10 +1196,11 @@ class ReplicaSet:
             replica.queue.drain()
             for rid in pending:
                 self._requeue(rid, replica.id,
-                              reason=f"replica_failure:{kind}")
+                              reason=f"replica_failure:{kind}", at=now)
             self._cond.notify_all()
 
-    def _requeue(self, rid: int, from_replica: int, reason: str) -> None:
+    def _requeue(self, rid: int, from_replica: int, reason: str,
+                 at: float | None = None) -> None:
         entry = self.journal.entries[rid]
         retries_used = max(entry.attempts - 1, 0)
         if retries_used >= self.retry_limit:
@@ -915,12 +1213,178 @@ class ReplicaSet:
         if req is None:
             return   # stream already complete — nothing to resume
         if not self._route(req, retry=True, from_replica=from_replica,
-                           reason=reason):
+                           reason=reason, at=at):
             self.journal.finalize(rid, "lost")
             self.tracer.event("retry_exhausted", rid=rid,
                               attempts=entry.attempts,
                               limit=self.retry_limit,
                               error="no surviving replica")
+
+    # ---------------------------------------------------------- handoff
+    def _handoff_hook(self, replica: _Replica):
+        """The prefill batcher's ``handoff_out`` callback (runs inline in
+        the prefill replica's serving loop, right after the slot was
+        extracted and evicted)."""
+        def hook(req: Request, payload: dict[str, Any]) -> None:
+            self._deliver_handoff(replica, req, payload)
+        return hook
+
+    def _deliver_handoff(self, src: _Replica, req: Request,
+                         payload: dict[str, Any]) -> None:
+        """Route a finished prefill's serialized KV to a decode replica.
+
+        The payload rides the fleet queue inside the request
+        (``Request.handoff``); the decode batcher restores it into a
+        slot instead of prefilling.  Transfer takes ``handoff_s`` of
+        fleet time, charged inside the request's TTFT (arrival →
+        first-token, the PR 7 discipline).  With no decode replica
+        serving, the handoff is DROPPED and the request re-enters the
+        retry path (re-prefill on a surviving prefill replica) — the
+        ledger identity ``initiated == delivered + dropped`` and the
+        journal's single-phase accounting keep a dropped handoff from
+        double-counting or vanishing."""
+        with self._lock:
+            self._handoffs_initiated += 1
+            src_t = self._clock_for(src).now()
+            decode = [r for r in self._serving() if r.role == "decode"]
+            if not decode:
+                self._handoffs_dropped += 1
+                self.tracer.event("handoff_dropped", rid=req.rid,
+                                  from_replica=src.id)
+                self.tracer.counter("handoffs_dropped")
+                # fence first (same discipline as failover), then retry
+                self.journal.mark_failed([req.rid], src_t)
+                self._requeue(req.rid, src.id, reason="handoff_no_decode",
+                              at=src_t)
+                return
+            target = self.replicas[self.journal.least_loaded(
+                [r.id for r in decode])]
+            arrive = src_t + self.handoff_s
+            # a transfer, not a retry: no attempt consumed, phase flips
+            self.journal.assign(req.rid, target.id, arrive, transfer=True)
+            self.journal.set_phase(req.rid, "decode")
+            self._handoffs_delivered += 1
+            hreq = dataclasses.replace(
+                req, handoff=payload,
+                arrival_s=max(req.arrival_s, arrive))
+            self.tracer.event("kv_handoff", rid=req.rid,
+                              from_replica=src.id, to_replica=target.id,
+                              blocks=len(payload["blocks"]),
+                              length=int(payload["length"]))
+            self.tracer.counter("handoffs_delivered")
+            target.queue.push(hreq)
+            target.work.set()
+            self._cond.notify_all()
+
+    # ---------------------------------------------------------- autoscale
+    def _autoscale_tick(self) -> None:
+        """One scaling decision, evaluated at the run coordinator's poll
+        boundary (threaded) or between sequential rounds.  At most one
+        action per cooldown window: scale-up wakes ONE dormant replica
+        when arrived backlog per admitting replica crosses the high
+        watermark; scale-down retires ONE replica that held no arrived
+        work for ``idle_s``.  Also re-arms every serving replica's
+        bounded serving slice."""
+        pol = self.autoscale
+        if pol is None or self.journal is None:
+            return
+        with self._lock:
+            serving = self._serving()
+            if not serving:
+                return
+            now = self._fleet_now()
+            for r in serving:
+                self._slice_end[r.id] = (self._clock_for(r).now()
+                                         + pol.slice_s)
+            n_max = pol.max_replicas or len(self.replicas)
+            admitting = max(len(serving) - self._draining, 1)
+            backlog = sum(r.queue.depth(now) for r in serving)
+            # idle bookkeeping runs every tick (cooldown only gates the
+            # actions, not the timers)
+            idle = []
+            for r in serving:
+                if (r.queue.depth(now) == 0 and not r.busy
+                        and not (self._swap is not None
+                                 and self._swap.get("active") == r.id)):
+                    if r.idle_since is None:
+                        r.idle_since = now
+                    idle.append(r)
+                else:
+                    r.idle_since = None
+            if (self._last_scale_t is not None
+                    and now - self._last_scale_t < pol.cooldown_s):
+                return
+            if (backlog > pol.high_watermark * admitting
+                    and len(serving) < n_max):
+                dormant = [r for r in self.replicas
+                           if r.state == "dormant"]
+                if dormant:
+                    self._scale_up(dormant[0], now, backlog)
+                    return
+            if len(serving) > pol.min_replicas:
+                for r in reversed(idle):   # highest id retires first
+                    if now - r.idle_since >= pol.idle_s:
+                        self._scale_down(r, now)
+                        return
+
+    def _scale_up(self, replica: _Replica, now: float,
+                  backlog: int) -> None:
+        """Wake a dormant replica and rebalance queued work over the
+        grown fleet (routing happened upfront — without the rebalance
+        the new replica would idle to the end of the trace)."""
+        replica.state = "serving"
+        replica.idle_since = None
+        replica.serve_start = now
+        self._scale_ups += 1
+        self._last_scale_t = now
+        self._scale_events.append(
+            {"action": "up", "replica": replica.id, "t": now,
+             "backlog": int(backlog), "serving": len(self._serving())})
+        self.tracer.event("scale_up", replica=replica.id,
+                          backlog=int(backlog),
+                          serving=len(self._serving()))
+        self.tracer.counter("scale_ups")
+        moved: list[Request] = []
+        for r in self._serving():
+            if r.id != replica.id:
+                moved.extend(r.queue.drain())
+        serving_ids = [r.id for r in self._serving()]
+        for req in sorted(moved, key=lambda q: (q.arrival_s, q.rid)):
+            target = self.replicas[self.journal.least_loaded(serving_ids)]
+            self.journal.assign(req.rid, target.id, now, transfer=True)
+            target.queue.push(req)
+            target.work.set()
+        if self.threaded and self._run_live:
+            self._start_worker(replica)
+
+    def _scale_down(self, replica: _Replica, now: float) -> None:
+        """Retire one idle serving replica; its not-yet-arrived
+        assignments transfer to the survivors (a transfer, not a retry —
+        no attempt consumed)."""
+        replica.state = "dormant"
+        replica.idle_since = None
+        if replica.serve_start is not None:
+            self._replica_seconds += max(now - replica.serve_start, 0.0)
+            replica.serve_start = None
+        self._scale_downs += 1
+        self._last_scale_t = now
+        self._scale_events.append(
+            {"action": "down", "replica": replica.id, "t": now,
+             "serving": len(self._serving())})
+        self.tracer.event("scale_down", replica=replica.id,
+                          serving=len(self._serving()))
+        self.tracer.counter("scale_downs")
+        replica.work.set()   # the worker observes dormant and exits
+        leftovers = replica.queue.drain()
+        serving_ids = [r.id for r in self._serving()]
+        for req in sorted(leftovers, key=lambda q: (q.arrival_s, q.rid)):
+            if not serving_ids:
+                self.journal.finalize(req.rid, "lost")
+                continue
+            target = self.replicas[self.journal.least_loaded(serving_ids)]
+            self.journal.assign(req.rid, target.id, now, transfer=True)
+            target.queue.push(req)
+            target.work.set()
 
     # ---------------------------------------------------------- hot swap
     def schedule_swap(self, params, draft_params=None, *,
@@ -1031,6 +1495,10 @@ class ReplicaSet:
         self._absorb(replica, summary)
         if summary.get("preempted") == "weight_swap":
             self._perform_swap(replica)
+        elif summary.get("preempted") == "autoscale_slice":
+            # benign: the slice expired; dis-arm it so the next run is
+            # not preempted on entry (the next tick re-arms)
+            self._slice_end.pop(replica.id, None)
         with self._cond:
             self._cond.notify_all()
 
@@ -1082,6 +1550,7 @@ class ReplicaSet:
                     break
             progressed = False
             self._sample_timeline()
+            self._autoscale_tick()
             for replica in self.replicas:
                 if replica.state != "serving":
                     continue
@@ -1172,26 +1641,40 @@ class ReplicaSet:
         self.journal = RequestJournal(requests)
         self._on_token = on_token
         offered = len(requests)
+        if self.autoscale is not None:
+            # start at the floor; the rest of the set sleeps until queue
+            # pressure wakes it (failed replicas stay dead)
+            live = [r for r in self.replicas if r.state != "failed"]
+            for idx, replica in enumerate(live):
+                replica.state = ("serving"
+                                 if idx < self.autoscale.min_replicas
+                                 else "dormant")
         self.min_admitting_replicas = len(self._serving())
         if self.slo is not None:
             self.slo.reset()
         self.clock.start()
-        t_start = self.clock.now()
+        for lane in self._lanes.values():
+            lane.start()   # every lane shares the run epoch
+        t_start = self._t_start = self._fleet_now()
+        for replica in self.replicas:
+            replica.idle_since = None
+            replica.serve_start = (t_start if replica.state == "serving"
+                                   else None)
         for req in requests:
             if not self._route(req):
                 self.journal.finalize(req.rid, "lost")
         with self._lock:
             self._maybe_start_swap()   # after_completions == 0 case
+            self._autoscale_tick()     # arm the first serving slices
         if self.threaded:
+            self._run_live = True
             self._wd_stop = threading.Event()
             wd = None
             if self.watchdog_timeout_s > 0:
                 wd = threading.Thread(target=self._watchdog, daemon=True)
                 wd.start()
             for replica in self._serving():
-                replica.thread = threading.Thread(
-                    target=self._worker, args=(replica,), daemon=True)
-                replica.thread.start()
+                self._start_worker(replica)
             try:
                 with self._cond:
                     while not self.journal.all_terminal():
@@ -1209,8 +1692,10 @@ class ReplicaSet:
                         if not self._serving():
                             break
                         self._sample_timeline()
+                        self._autoscale_tick()
                         self._cond.wait(0.05)
             finally:
+                self._run_live = False
                 self._wd_stop.set()
                 for replica in self.replicas:
                     replica.stop.set()
@@ -1237,8 +1722,13 @@ class ReplicaSet:
                               completed=self.journal.counts()["done"],
                               unserved=self.journal.counts()["unserved"])
         self._sample_timeline()   # final state (post-failover cliffs)
-        elapsed = self.clock.now() - t_start
+        elapsed = self._fleet_now() - t_start
         return self._summary(offered, elapsed)
+
+    def _start_worker(self, replica: _Replica) -> None:
+        replica.thread = threading.Thread(
+            target=self._worker, args=(replica,), daemon=True)
+        replica.thread.start()
 
     def close(self, timeout_s: float = 10.0) -> None:
         """Join worker threads left behind by ``run`` (a fenced zombie —
@@ -1371,7 +1861,8 @@ class ReplicaSet:
             "decode_iterations": int(self._sums.get(
                 "decode_iterations", 0)),
             "prefills": int(self._sums.get("prefills", 0)),
-            "prefill_chunk": self.replicas[0].batcher.prefill_chunk,
+            "prefill_chunk": max(r.batcher.prefill_chunk
+                                 for r in self.replicas),
             "prefill_chunks": int(self._sums.get("prefill_chunks", 0)),
             "prefill_tokens": prefill_tokens,
             "decode_tokens": decode_tokens,
@@ -1402,7 +1893,7 @@ class ReplicaSet:
             "serve_queue_wait_p99_s": qw.quantile(0.99),
             "queue_depth_p95": qd.quantile(0.95),
             "queue_depth_high_watermark": depth_hwm,
-            "queue_cap": self.replicas[0].batcher.queue_cap,
+            "queue_cap": max(r.batcher.queue_cap for r in self.replicas),
             "offered": offered,
             "admitted": counts["done"],
             "shed_requests": counts["shed"],
@@ -1472,6 +1963,50 @@ class ReplicaSet:
                                            replica=r.id)
                         for r in self.replicas)), default=None)
             summary["timeline_overhead_s"] = self.timeline.overhead_s
+        # ---- round-18 keys, each gated on its feature so the flag-off
+        # summary key set stays byte-identical to round 17 (parity pin)
+        if (self.roles is not None or self.autoscale is not None
+                or self.parallel_lanes):
+            end = self._t_start + elapsed
+            summary["serve_replica_seconds"] = self._replica_seconds + sum(
+                max(end - r.serve_start, 0.0) for r in self.replicas
+                if r.serve_start is not None)
+        if self.parallel_lanes:
+            summary["serve_parallel_lanes"] = True
+        if self.routing != "least-loaded":
+            summary["serve_routing"] = self.routing
+            # the fleet-wide hit rate under THIS router, on this trace —
+            # the number `analyze diff` gates against a least-loaded
+            # baseline window of the same seeded trace
+            summary["serve_fleet_prefix_hit_rate"] = hit_rate
+        if self.roles is not None:
+            role_counts = journal.role_counts()
+            # per-role conservation: phase is single-valued, so the two
+            # partitions sum to the fleet identity admitted+shed+unserved
+            # == offered exactly — a dropped handoff flips its request
+            # back to the prefill partition, counted once, never twice
+            summary["serve_disagg"] = {
+                "prefill_replicas": sum(1 for r in self.replicas
+                                        if r.role == "prefill"),
+                "decode_replicas": sum(1 for r in self.replicas
+                                       if r.role == "decode"),
+                "handoff_s": self.handoff_s,
+                "handoffs_initiated": self._handoffs_initiated,
+                "handoffs_delivered": self._handoffs_delivered,
+                "handoffs_dropped": self._handoffs_dropped,
+                "per_role": role_counts,
+            }
+        if self.autoscale is not None:
+            pol = self.autoscale
+            summary["autoscale"] = {
+                "min_replicas": pol.min_replicas,
+                "max_replicas": pol.max_replicas or len(self.replicas),
+                "high_watermark": pol.high_watermark,
+                "scale_ups": self._scale_ups,
+                "scale_downs": self._scale_downs,
+                "events": self._scale_events[:64],
+                "serving_replicas_final": len(self._serving()),
+            }
         return summary
 
 
@@ -1489,6 +2024,7 @@ def build_replica_kvs(model, params, n_replicas: int, slots: int,
 
 
 __all__ = [
+    "AutoscalePolicy",
     "CorruptionDetected",
     "FaultInjector",
     "FaultSpec",

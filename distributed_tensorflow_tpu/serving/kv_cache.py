@@ -254,6 +254,8 @@ class SlotKVCache:
         self._verifies: dict[int, object] = {}         # speculative verify
         self._read_block = None                        # prefix-pool extract
         self._write_block = None                       # prefix-pool restore
+        self._handoff_read = None                      # disagg KV handoff
+        self._handoff_write = None
 
     def _place_params(self, params):
         """Param placement rule (shared by __init__ and ``swap_params``):
@@ -467,6 +469,43 @@ class SlotKVCache:
         return (self._jit(read, "kv_prefix_read_block"),
                 self._jit(write, "kv_prefix_write_block", donate_argnums=0))
 
+    def _handoff_block(self) -> int:
+        """Block granularity of the handoff transfer format.  Prefers the
+        prefix-pool block size (so a handoff payload is the same shape a
+        pool entry would be) but falls back to one whole-row block when
+        ``prefix_block`` does not divide ``max_len`` — a partial tail
+        block would make ``dynamic_slice`` clamp its start and silently
+        read shifted positions."""
+        return (self.prefix_block if self.max_len % self.prefix_block == 0
+                else self.max_len)
+
+    def _handoff_ops(self):
+        """Jitted handoff block copy programs (compiled once each;
+        slot/start are traced) — the ``_block_ops`` machinery pointed at
+        the disaggregated prefill→decode transfer: ``read`` slices one
+        handoff block of a slot's KV out of every cache leaf, ``write``
+        scatters a transferred block into the receiving table's slot.
+        int8 scale leaves are cache leaves like any other, so they ride
+        the same tree map and the restored KV is byte-exact."""
+        hb = self._handoff_block()
+
+        def read(cache, slot, start):
+            return jax.tree.map(
+                lambda t: lax.dynamic_slice(
+                    t, (slot, start) + (0,) * (t.ndim - 2),
+                    (1, hb) + t.shape[2:]), cache)
+
+        def write(cache, entry, slot, start):
+            return jax.tree.map(
+                lambda t, e: lax.dynamic_update_slice(
+                    t, e.astype(t.dtype),
+                    (slot, start) + (0,) * (t.ndim - 2)),
+                cache, entry)
+
+        return (self._jit(read, "kv_handoff_read_block"),
+                self._jit(write, "kv_handoff_write_block",
+                          donate_argnums=0))
+
     # ------------------------------------------------------------ slot API
     @property
     def free_slots(self) -> list[int]:
@@ -649,6 +688,94 @@ class SlotKVCache:
         del self._pending[slot]
         self.reserved[slot] = False
         self.lengths[slot] = 0
+
+    # ------------------------------------------------------- KV handoff
+    def _claim_restore_slot(self, length: int, slot: int | None) -> int:
+        """Shared restore-side validation (monolithic + paged): the
+        restored sequence must leave room to decode, exactly insert's
+        admission rule."""
+        if not 1 <= length < self.max_len:
+            raise ValueError(
+                f"handoff length {length} must lie in [1, max_len="
+                f"{self.max_len}) — a restored slot needs room to decode")
+        if slot is None:
+            free = self.free_slots
+            if not free:
+                raise RuntimeError(
+                    "no free slot — evict before restoring a handoff")
+            slot = free[0]
+        elif self.active[slot] or self.reserved[slot]:
+            raise RuntimeError(f"slot {slot} is active — evict it first")
+        return slot
+
+    def _check_handoff_payload(self, payload: dict, block: int) -> int:
+        """Transfer-format compatibility gate: a payload restores only
+        into a table with the same layout, block granularity, storage
+        dtype and max_len — anything else would reinterpret bytes."""
+        for key, want in (("layout", self.kv_layout),
+                          ("block", block),
+                          ("kv_dtype", self.kv_dtype),
+                          ("max_len", self.max_len)):
+            if payload.get(key) != want:
+                raise ValueError(
+                    f"handoff payload {key}={payload.get(key)!r} does not "
+                    f"match the receiving table ({key}={want!r}): prefill "
+                    f"and decode replicas must share the KV configuration")
+        return int(payload["length"])
+
+    def extract_handoff(self, slot: int) -> dict:
+        """Serialize an active slot's KV state into a host-side transfer
+        payload — the disaggregated-fleet handoff: a prefill replica
+        extracts the finished prompt KV here and a decode replica
+        ``restore_handoff``s it into its own table.
+
+        The payload is a dict of plain host numpy trees (one per handoff
+        block, sliced by the jitted ``_handoff_ops`` read program and
+        ``device_get``; garbage positions past ``length`` in the final
+        block travel along but are invisible — validity is length-driven
+        on the receiving side too).  Under int8 storage the f32 scale
+        leaves ride the same block trees, so restore is byte-exact and a
+        greedy continuation on the decode replica is bitwise what the
+        prefill replica would have produced.  The slot stays active:
+        the caller evicts after a successful transfer."""
+        if not self.active[slot]:
+            raise RuntimeError(f"slot {slot} is not active")
+        if self._handoff_read is None:
+            self._handoff_read, self._handoff_write = self._handoff_ops()
+        hb = self._handoff_block()
+        length = int(self.lengths[slot])
+        blocks = []
+        for start in range(0, length, hb):
+            entry = self._handoff_read(
+                self.cache, jnp.int32(slot), jnp.int32(start))
+            blocks.append(jax.device_get(entry))
+        return {"layout": self.kv_layout, "block": hb, "length": length,
+                "token": int(self.tokens[slot]),
+                "kv_dtype": self.kv_dtype, "max_len": self.max_len,
+                "blocks": blocks}
+
+    def restore_handoff(self, payload: dict,
+                        slot: int | None = None) -> tuple[int, int]:
+        """Admit a transferred KV payload into a free slot; returns
+        ``(slot, first_token)`` exactly like ``insert`` — the first
+        generated token was already sampled by the prefill replica and
+        travels in the payload, so the receiving scheduler delivers it
+        without running any program.  The slot comes up active at the
+        transferred length and the next ``advance`` continues the
+        sequence bitwise (same storage dtype both sides)."""
+        length = self._check_handoff_payload(payload, self._handoff_block())
+        slot = self._claim_restore_slot(length, slot)
+        if self._handoff_write is None:
+            self._handoff_read, self._handoff_write = self._handoff_ops()
+        hb = self._handoff_block()
+        for b, entry in enumerate(payload["blocks"]):
+            entry = jax.tree.map(self._put_repl, entry)
+            self.cache = self._handoff_write(
+                self.cache, entry, jnp.int32(slot), jnp.int32(b * hb))
+        self.active[slot] = True
+        self.lengths[slot] = length
+        self.tokens[slot] = token = int(payload["token"])
+        return slot, token
 
     # ------------------------------------------------------- prefix pool
     def _prefix_keys(self, prompt: np.ndarray, n_blocks: int):
@@ -894,11 +1021,18 @@ class SlotKVCache:
         width actually used.  With chunking, the prefix pool and
         speculative decoding off, the chunk/block/verify counts are 0 and
         the compiled set is exactly PR 7's."""
-        return {"decode_steps": 1,
-                "prefill_buckets": len(self._prefills),
-                "prefill_chunk_buckets": len(self._chunks),
-                "prefix_block_ops": (0 if self._read_block is None else 2),
-                "verify_widths": len(self._verifies)}
+        out = {"decode_steps": 1,
+               "prefill_buckets": len(self._prefills),
+               "prefill_chunk_buckets": len(self._chunks),
+               "prefix_block_ops": (0 if self._read_block is None else 2),
+               "verify_widths": len(self._verifies)}
+        # the disaggregated handoff read/write pair appears only once a
+        # handoff actually ran: with the feature off the compiled set —
+        # keys included — is exactly the round-17 one (the flag-off
+        # program-set parity pin)
+        if self._handoff_read is not None:
+            out["handoff_block_ops"] = 2
+        return out
 
     def timeline_gauges(self) -> dict[str, float]:
         """Host-side gauge snapshot for the ``--timeline`` sampler: numpy
@@ -1117,6 +1251,8 @@ class PagedSlotKVCache(SlotKVCache):
         self._read_block = None                  # monolithic pool programs
         self._write_block = None                 # never built under paged
         self._copy_block = None                  # CoW block copy (lazy)
+        self._handoff_read = None                # disagg KV handoff (lazy)
+        self._handoff_write = None
 
     # -------------------------------------------------- block bookkeeping
     @property
@@ -1351,6 +1487,98 @@ class PagedSlotKVCache(SlotKVCache):
         self.active[slot] = False
         self.lengths[slot] = 0
         self.tokens[slot] = 0
+
+    # ------------------------------------------------------- KV handoff
+    def _handoff_block(self) -> int:
+        """Paged handoff granularity IS the physical block: the transfer
+        format serializes whole pool blocks by id, so block size and
+        table block size agree by construction."""
+        return self.paged_block
+
+    def _handoff_ops(self):
+        """Physical-block handoff programs (``_build_copy``'s slicing
+        aimed across tables instead of within one): ``read`` slices one
+        physical block out of every pool leaf, ``write`` scatters a
+        transferred block into a freshly-allocated block of the
+        receiving pool.  Block ids are traced — one compile each."""
+        def read(cache, bid):
+            return jax.tree.map(
+                lambda t: lax.dynamic_slice(
+                    t, (bid,) + (0,) * (t.ndim - 1),
+                    (1,) + t.shape[1:]), cache)
+
+        def write(cache, entry, bid):
+            return jax.tree.map(
+                lambda t, e: lax.dynamic_update_slice(
+                    t, e.astype(t.dtype), (bid,) + (0,) * (t.ndim - 1)),
+                cache, entry)
+
+        return (self._jit(read, "kv_handoff_read_block"),
+                self._jit(write, "kv_handoff_write_block",
+                          donate_argnums=0))
+
+    def extract_handoff(self, slot: int) -> dict:
+        """Paged extract: serialize the physical blocks backing the
+        slot's first ``ceil(length/block)`` table entries (aliased
+        prefix blocks serialize like private ones — the payload is
+        self-contained, the receiving pool shares nothing with ours)."""
+        if not self.active[slot]:
+            raise RuntimeError(f"slot {slot} is not active")
+        if self._handoff_read is None:
+            self._handoff_read, self._handoff_write = self._handoff_ops()
+        length = int(self.lengths[slot])
+        blk = self.paged_block
+        n = -(-length // blk)
+        sb = self._slot_blocks[slot]
+        if len(sb) < n:
+            raise RuntimeError(
+                f"slot {slot} block table covers {len(sb)} blocks but "
+                f"length {length} needs {n} — block bookkeeping bug")
+        blocks = []
+        for bid in sb[:n]:
+            entry = self._handoff_read(self.cache, jnp.int32(bid))
+            blocks.append(jax.device_get(entry))
+        return {"layout": "paged", "block": blk, "length": length,
+                "token": int(self.tokens[slot]),
+                "kv_dtype": self.kv_dtype, "max_len": self.max_len,
+                "blocks": blocks}
+
+    def restore_handoff(self, payload: dict,
+                        slot: int | None = None) -> tuple[int, int]:
+        """Paged restore: allocate the covering blocks, scatter the
+        payload in, point the slot's table at them.  Failure anywhere —
+        pool exhausted mid-allocation, a device error mid-write —
+        releases every block this restore claimed before re-raising, so
+        a failed handoff admission cannot leak pool blocks."""
+        blk = self.paged_block
+        length = self._check_handoff_payload(payload, blk)
+        n = -(-length // blk)
+        if len(payload["blocks"]) != n:
+            raise ValueError(
+                f"handoff payload carries {len(payload['blocks'])} blocks "
+                f"but length {length} needs {n}")
+        slot = self._claim_restore_slot(length, slot)
+        if self._handoff_write is None:
+            self._handoff_read, self._handoff_write = self._handoff_ops()
+        sb = self._slot_blocks[slot]
+        try:
+            for j, entry in enumerate(payload["blocks"]):
+                bid = self._alloc_block()
+                sb.append(bid)
+                self.block_tables_np[slot, j] = bid
+                entry = jax.tree.map(self._put_repl, entry)
+                self.cache = self._handoff_write(
+                    self.cache, entry, jnp.int32(bid))
+        except BaseException:
+            # slot is still inactive — releasing its blocks restores the
+            # pool exactly (refcounts were 1: nothing aliased a block
+            # that never finished arriving)
+            self._release_slot_blocks(slot)
+            raise
+        self.active[slot] = True
+        self.lengths[slot] = length
+        self.tokens[slot] = token = int(payload["token"])
+        return slot, token
 
     # ------------------------------------------------------- prefix pool
     def _restore_prefix(self, prompt: np.ndarray, lp: int,

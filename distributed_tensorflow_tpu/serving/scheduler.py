@@ -176,6 +176,11 @@ class Request:
     max_new_tokens: int
     arrival_s: float = 0.0
     eos_id: int | None = None
+    # disaggregated fleet: a serialized KV payload (SlotKVCache
+    # extract_handoff) attached by a prefill replica's handoff hook —
+    # the decode replica admits by restoring it instead of prefilling.
+    # None everywhere outside the disaggregated path.
+    handoff: dict | None = None
 
 
 class RequestQueue:
@@ -341,7 +346,8 @@ class ContinuousBatcher:
                  prefill_chunk: int = 0, metrics=None, slo=None,
                  queue_cap: int = 0, should_stop=None,
                  draft_kv: SlotKVCache | None = None, draft_k: int = 4,
-                 timeline=None, timeline_tag: int | None = None):
+                 timeline=None, timeline_tag: int | None = None,
+                 role: str | None = None, handoff_out=None):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be continuous|static, got {mode}")
         if prefill_chunk < 0:
@@ -379,6 +385,26 @@ class ContinuousBatcher:
                     f"draft max_len ({draft_kv.max_len}) must cover the "
                     f"target's ({kv.max_len}): the draft mirrors every "
                     f"slot position")
+        # disaggregated fleet roles (--serve-disaggregate): a 'prefill'
+        # batcher runs admission + (chunked) prefill only and hands each
+        # finished slot's KV to `handoff_out(req, payload)` instead of
+        # decoding; a 'decode' batcher admits handoff-carrying requests
+        # by restoring the payload.  role=None is the homogeneous batcher,
+        # byte-identical to round 17.
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"role must be None|prefill|decode, got {role!r}")
+        if (role == "prefill") != (handoff_out is not None):
+            raise ValueError(
+                "role='prefill' and handoff_out go together: the prefill "
+                "batcher needs a delivery hook for finished KV, and only "
+                "a prefill batcher may have one")
+        if role == "prefill" and draft_kv is not None:
+            raise ValueError(
+                "speculative decoding cannot ride a prefill-role batcher: "
+                "it never decodes — attach the draft to decode replicas")
+        self.role = role
+        self.handoff_out = handoff_out
         self.draft_kv = draft_kv
         self.draft_k = int(draft_k)
         self.kv = kv
@@ -425,21 +451,45 @@ class ContinuousBatcher:
                 f"request {req.rid}: max_new_tokens must be positive")
         return lp
 
-    def _admit(self, req: Request, live: dict[int, _Live]) -> int:
+    def _admit(self, req: Request, live: dict[int, _Live]) -> int | None:
         kv, tracer = self.kv, self.tracer
         lp = self._check_capacity(req)
         t_claim = self.clock.now()
         req_span = tracer.span("request", rid=req.rid, prompt_len=lp,
                                max_new_tokens=req.max_new_tokens)
         req_attrs = req_span.__enter__() or {}
-        before = kv.prefill_tokens_computed
-        with tracer.span("prefill", rid=req.rid, prompt_len=lp):
-            slot, first = kv.insert(req.prompt)
+        if req.handoff is not None:
+            # disaggregated decode-side admission: the prompt KV arrives
+            # serialized from a prefill replica — restore it instead of
+            # prefilling.  The first token was already sampled by the
+            # prefill replica's final chunk and rides the payload; no
+            # prefill program runs here, so a long prompt can never
+            # share this replica's iteration with live decodes.
+            if self.role != "decode":
+                raise ValueError(
+                    f"request {req.rid} carries a KV handoff but this "
+                    f"batcher's role is {self.role!r} — only decode-role "
+                    f"batchers admit handoffs")
+            with tracer.span("kv_handoff_restore", rid=req.rid,
+                             length=int(req.handoff["length"])):
+                slot, first = kv.restore_handoff(req.handoff)
+            self._handoffs_in += 1
+        else:
+            before = kv.prefill_tokens_computed
+            with tracer.span("prefill", rid=req.rid, prompt_len=lp):
+                slot, first = kv.insert(req.prompt)
+            self.clock.on_prefill(kv.prefill_tokens_computed - before)
         if hasattr(kv, "note_admission"):
             # register the paged block budget (prompt + decode growth) so
             # can_admit's outstanding ledger covers this slot's worst case
             kv.note_admission(slot, lp + req.max_new_tokens)
-        self.clock.on_prefill(kv.prefill_tokens_computed - before)
+        if self.handoff_out is not None:
+            # prefill role: the finished slot's KV leaves for a decode
+            # replica — no local decode, no local token delivery (the
+            # decode replica emits the payload's first token, so TTFT is
+            # still charged arrival→first-token INCLUDING the handoff)
+            self._handoff(req, slot, req_span, req_attrs)
+            return None
         now = self.clock.now()
         result = RequestResult(
             rid=req.rid, prompt_len=lp, tokens=[first],
@@ -476,9 +526,14 @@ class ContinuousBatcher:
                          "queue_wait_s": t_claim - req.arrival_s}
 
     def _promote(self, slot: int, pend: dict, first: int,
-                 live: dict[int, _Live]) -> None:
-        """Final chunk done: the slot joins the decode table."""
+                 live: dict[int, _Live]) -> bool:
+        """Final chunk done: the slot joins the decode table — or, on a
+        prefill-role batcher, leaves for a decode replica (returns False:
+        the caller must not deliver the first token locally)."""
         req = pend["req"]
+        if self.handoff_out is not None:
+            self._handoff(req, slot, pend["span"], pend["attrs"])
+            return False
         now = self.clock.now()
         result = RequestResult(
             rid=req.rid, prompt_len=pend["lp"], tokens=[first],
@@ -493,6 +548,33 @@ class ContinuousBatcher:
         self._draft_admit(req.prompt, slot, first)
         if self._finished(live[slot]):
             self._finish(slot, live)
+        return True
+
+    def _handoff(self, req: Request, slot: int, span, attrs) -> None:
+        """Prefill-role completion: serialize the finished slot's KV
+        (SlotKVCache.extract_handoff — the jitted block read programs +
+        device_get), free the slot, and deliver (req, payload) to the
+        fleet's handoff hook.  The evict-before-raise guard is the
+        no-KV-leak fence the chaos tests pin: at this point the slot is
+        visible to NEITHER run()'s live nor its pending cleanup, so a
+        fault injected into the extract (or a real device error) must
+        release the slot — under paging, its blocks and refcounts —
+        right here, before the failure surfaces to the supervisor."""
+        kv = self.kv
+        try:
+            with self.tracer.span("kv_handoff", rid=req.rid, slot=slot,
+                                  length=int(kv.lengths[slot])):
+                payload = kv.extract_handoff(slot)
+        except BaseException:
+            kv.evict(slot)
+            span.__exit__(None, None, None)
+            raise
+        kv.evict(slot)
+        self._handoffs_out += 1
+        attrs.update(handed_off=True,
+                     handoff_blocks=len(payload["blocks"]))
+        span.__exit__(None, None, None)
+        self.handoff_out(req, payload)
 
     def _draft_admit(self, prompt, slot: int, first: int) -> None:
         """Speculative decode: admit the same prompt into the draft
@@ -644,7 +726,7 @@ class ContinuousBatcher:
                 else:
                     first = self._admit(req, live)
                     prefills += 1
-                    if on_token is not None:
+                    if first is not None and on_token is not None:
                         on_token(req.rid, first)  # the prefill's own token
             # bounded admission (overload mode): whatever arrived beyond
             # the queue-depth cap after this round's admissions is shed
@@ -689,8 +771,8 @@ class ContinuousBatcher:
                 if first is not None:
                     pending.pop(slot)
                     prefills += 1
-                    self._promote(slot, pend, first, live)
-                    if on_token is not None:
+                    if self._promote(slot, pend, first, live) \
+                            and on_token is not None:
                         on_token(pend["req"].rid, first)
             if not live:
                 if pending:
@@ -841,6 +923,9 @@ class ContinuousBatcher:
         self._accepted = 0
         self._draft_iterations = 0
         self._draft_catchup = 0
+        # disaggregated handoff ledger (stays zero with role=None)
+        self._handoffs_out = 0
+        self._handoffs_in = 0
         if self.slo is not None:
             self.slo.reset()   # one monitor measures one window
         live: dict[int, _Live] = {}
@@ -1050,6 +1135,13 @@ class ContinuousBatcher:
                 for k in phases_after},
             "results": results,
         }
+        if self.role is not None:
+            # disaggregated-role keys ride the summary ONLY when a role
+            # is assigned: the role=None key set stays byte-identical to
+            # round 17 (the flag-off summary-key parity pin)
+            summary["serve_role"] = self.role
+            summary["handoffs_out"] = self._handoffs_out
+            summary["handoffs_in"] = self._handoffs_in
         if self.timeline is not None:
             # timeline-derived keys ride the summary ONLY when sampling is
             # on: the flag-off key set stays byte-identical (parity pin)

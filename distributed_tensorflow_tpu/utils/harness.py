@@ -370,6 +370,39 @@ class ExperimentConfig:
                                            # admissions when the free list
                                            # cannot cover a request's
                                            # worst-case block need
+    serve_disaggregate: str | None = None  # 'P:D': disaggregated fleet —
+                                           # P prefill replicas (admission
+                                           # + chunked prefill, then a
+                                           # serialized KV handoff) and D
+                                           # decode replicas (never share
+                                           # an iteration with a long
+                                           # prompt).  Overrides
+                                           # serve_replicas (P+D total);
+                                           # handoff time is charged
+                                           # inside TTFT.  Decode-side
+                                           # tables carry no prefix pool
+                                           # (pool warmth lives where
+                                           # prefill runs).  None = the
+                                           # homogeneous fleet, summary-
+                                           # key-identical to round 17
+    serve_routing: str = "least-loaded"    # fleet request routing:
+                                           # 'least-loaded' (PR 13) or
+                                           # 'affinity' — key on the
+                                           # chained SHA-256 digest of the
+                                           # first prefix block and land
+                                           # shared-prefix traffic where
+                                           # that block is already warm;
+                                           # adds serve_fleet_prefix_
+                                           # hit_rate to the summary
+    serve_autoscale: str | None = None     # 'MIN:MAX': queue-driven
+                                           # replica autoscaling — start
+                                           # at MIN serving replicas,
+                                           # scale toward MAX on arrived-
+                                           # backlog high watermark, drain
+                                           # an idle replica back down;
+                                           # serve_replica_seconds becomes
+                                           # the efficiency ledger.
+                                           # Homogeneous fleets only
 
 
 def enable_compile_cache(directory: str | os.PathLike) -> str:
@@ -2128,6 +2161,29 @@ def parse_draft_config(spec: str) -> dict[str, int] | None:
     return out
 
 
+def parse_disaggregate(spec: str) -> tuple[int, int]:
+    """``--serve-disaggregate`` parser: ``'P:D'`` → (prefill_replicas,
+    decode_replicas).  Both sides must be >= 1 — a disaggregated fleet
+    needs somewhere to prefill AND somewhere to decode (the handoff has
+    no same-replica fallback by design: falling back would silently
+    reintroduce the prefill/decode interference the mode exists to
+    remove)."""
+    p_s, colon, d_s = spec.partition(":")
+    try:
+        if not colon:
+            raise TypeError
+        p, d = int(p_s), int(d_s)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"--serve-disaggregate must be P:D (prefill:decode replica "
+            f"counts, e.g. 1:2), got '{spec}'") from None
+    if p < 1 or d < 1:
+        raise ValueError(
+            f"--serve-disaggregate needs at least one prefill and one "
+            f"decode replica, got '{spec}'")
+    return p, d
+
+
 def _resolve_serve_kv_dtype(name: str):
     """``--serve-kv-dtype`` resolver: float dtype names via
     models.resolve_dtype, plus ``'int8'`` — the quantized slot table
@@ -2236,6 +2292,50 @@ def _validate_serving(config: ExperimentConfig, ex: _Experiment,
     if config.serve_replicas < 1:
         raise ValueError(
             f"--serve-replicas must be >= 1, got {config.serve_replicas}")
+    n_fleet = max(config.serve_replicas, 1)
+    if config.serve_disaggregate is not None:
+        # round 18: --serve-disaggregate P:D builds a heterogeneous
+        # fleet of P prefill + D decode replicas (overriding
+        # --serve-replicas); the spec and its interactions are all
+        # knowable pre-train
+        p, d = parse_disaggregate(config.serve_disaggregate)
+        n_fleet = p + d
+        if config.serve_draft_config is not None:
+            raise ValueError(
+                "--serve-disaggregate cannot combine with "
+                "--serve-draft-config: speculative decoding drafts in "
+                "slot lockstep with its target table, which a KV "
+                "handoff across replicas would break")
+        if config.serve_hot_swap:
+            raise ValueError(
+                "--serve-disaggregate cannot combine with "
+                "--serve-hot-swap: the swap drill drains replicas "
+                "role-blind and could leave zero admitting prefill "
+                "replicas")
+    if config.serve_routing not in ("least-loaded", "affinity"):
+        raise ValueError(
+            f"--serve-routing must be 'least-loaded' or 'affinity', "
+            f"got {config.serve_routing!r}")
+    if config.serve_routing == "affinity" and not config.serve_prefix_cache:
+        raise ValueError(
+            "--serve-routing affinity keys on the prefix pool's block "
+            "digests; enable --serve-prefix-cache (> 0) or use "
+            "least-loaded routing")
+    if config.serve_autoscale is not None:
+        from distributed_tensorflow_tpu.serving.fleet import AutoscalePolicy
+
+        if config.serve_disaggregate is not None:
+            raise ValueError(
+                "--serve-autoscale drives a homogeneous fleet; it "
+                "cannot combine with --serve-disaggregate (per-role "
+                "scaling is future work)")
+        policy = AutoscalePolicy.parse(config.serve_autoscale)
+        n_max = policy.max_replicas or n_fleet
+        if n_max > n_fleet:
+            raise ValueError(
+                f"--serve-autoscale max ({n_max}) exceeds the built "
+                f"fleet (--serve-replicas {n_fleet}): autoscale wakes "
+                f"dormant replicas, it cannot build new ones")
     if config.serve_watchdog_s < 0:
         raise ValueError(
             f"--serve-watchdog must be >= 0 (0 = off), got "
@@ -2246,10 +2346,10 @@ def _validate_serving(config: ExperimentConfig, ex: _Experiment,
         from distributed_tensorflow_tpu.serving.fleet import FaultInjector
 
         for fault in FaultInjector.parse(config.serve_fault_spec):
-            if fault.replica >= config.serve_replicas:
+            if fault.replica >= n_fleet:
                 raise ValueError(
                     f"--serve-fault-spec targets replica {fault.replica} "
-                    f"but --serve-replicas is {config.serve_replicas}")
+                    f"but the fleet has {n_fleet} replicas")
     plen = config.serve_prompt_len
     if plen < 1 or plen > test_ds.x.shape[1]:
         raise ValueError(
@@ -2318,10 +2418,21 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
     # fleet mode (--serve-replicas / --serve-fault-spec / --serve-hot-
     # swap): N independent slot tables behind the ReplicaSet supervisor —
     # a fault spec or a hot-swap drill forces the fleet path even at one
-    # replica, so the supervision/journal machinery is what gets tested
-    n_replicas = max(config.serve_replicas, 1)
+    # replica, so the supervision/journal machinery is what gets tested.
+    # Round 18's heterogeneous flags (--serve-disaggregate P:D roles,
+    # --serve-routing affinity, --serve-autoscale MIN:MAX) are fleet
+    # concepts, so any of them forces the fleet path too.
+    roles = None
+    if config.serve_disaggregate is not None:
+        n_prefill, n_decode = parse_disaggregate(config.serve_disaggregate)
+        roles = ["prefill"] * n_prefill + ["decode"] * n_decode
+        n_replicas = n_prefill + n_decode
+    else:
+        n_replicas = max(config.serve_replicas, 1)
     fleet = (n_replicas > 1 or bool(config.serve_fault_spec)
-             or config.serve_hot_swap)
+             or config.serve_hot_swap or roles is not None
+             or config.serve_routing != "least-loaded"
+             or config.serve_autoscale is not None)
     kv_kwargs: dict[str, Any] = dict(
         mesh=mesh, kv_dtype=kv_dtype,
         prefix_cache_blocks=config.serve_prefix_cache,
@@ -2389,9 +2500,25 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
         from distributed_tensorflow_tpu.serving.fleet import (
             FaultInjector, ReplicaSet, build_replica_kvs)
 
-        kvs = [kv] + build_replica_kvs(
-            ex.engine.model, params, n_replicas - 1, config.serve_slots,
-            **kv_kwargs)
+        if roles is None:
+            kvs = [kv] + build_replica_kvs(
+                ex.engine.model, params, n_replicas - 1,
+                config.serve_slots, **kv_kwargs)
+        else:
+            # disaggregated fleets keep the prefix pool prefill-side
+            # only: decode replicas receive finished KV via handoff and
+            # never prefill, so a warm pool there would be dead memory —
+            # and the affinity router's hit accounting should reflect
+            # where reuse can actually happen.  Replica 0 (the ``kv``
+            # built above, pool included) is always a prefill replica
+            # because roles lists prefills first.
+            decode_kwargs = dict(kv_kwargs)
+            decode_kwargs["prefix_cache_blocks"] = 0
+            kvs = [kv]
+            for role in roles[1:]:
+                kvs += build_replica_kvs(
+                    ex.engine.model, params, 1, config.serve_slots,
+                    **(kv_kwargs if role == "prefill" else decode_kwargs))
         draft_kvs = None
         if draft_kv is not None:
             draft_kvs = [draft_kv] + build_replica_kvs(
@@ -2400,13 +2527,23 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
         injector = (FaultInjector(config.serve_fault_spec,
                                   seed=config.seed)
                     if config.serve_fault_spec else None)
+        fleet_kwargs: dict[str, Any] = {}
+        if roles is not None:
+            # conditional-kwarg pattern (same as the paged block above):
+            # the round-17 fleet construction stays byte-identical when
+            # the round-18 flags are off
+            fleet_kwargs.update(roles=roles)
+        if config.serve_routing != "least-loaded":
+            fleet_kwargs.update(routing=config.serve_routing)
+        if config.serve_autoscale is not None:
+            fleet_kwargs.update(autoscale=config.serve_autoscale)
         replica_set = ReplicaSet(
             kvs, tracer=tracer,
             prefill_chunk=config.serve_prefill_chunk,
             queue_cap=config.serve_queue_cap, slo=slo,
             draft_kvs=draft_kvs, draft_k=config.serve_draft_k,
             watchdog_timeout_s=config.serve_watchdog_s,
-            fault_injector=injector, timeline=timeline)
+            fault_injector=injector, timeline=timeline, **fleet_kwargs)
         if config.serve_hot_swap:
             # the drill: re-install the SAME trained params after half
             # the window — proves drain + swap_generations + N-1
