@@ -64,28 +64,27 @@ REPEATS = 5
 # bench harness itself is exercised in CI without TPU-scale compute)
 PER_CHIP_BATCH = int(os.environ.get("BENCH_PER_CHIP_BATCH", "512"))
 
-# Peak bf16 matmul FLOPs/s per chip, by device_kind substring.  First match
-# wins, so the specific v5 entries ("v5 lite"/"v5e"/"v5p") must precede the
-# bare "v5" fallback (some libtpu builds report v5p as just "TPU v5").
-# Public figures: v5e 197, v5p 459, v4 275, v3 123, v2 45, v6e/Trillium
-# 918 TFLOP/s.
-_PEAK_BF16 = (
-    ("v6 lite", 918e12), ("v6e", 918e12),
-    ("v5 lite", 197e12), ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
-
-
 def peak_flops(device_kind: str) -> float | None:
-    kind = device_kind.lower()
-    for sub, peak in _PEAK_BF16:
-        if sub in kind:
-            return peak
-    return None
+    """Peak bf16 matmul FLOPs/s per chip — delegates to the shared
+    observability peak table (observability/roofline.py, the single place
+    public chip figures and their revision live since round 19).  Same
+    contract as always: None for an unknown device_kind, never an
+    invented peak."""
+    from distributed_tensorflow_tpu.observability.roofline import (
+        device_peaks)
+
+    peaks = device_peaks(device_kind)
+    return peaks.flops_per_s["bf16"] if peaks is not None else None
+
+
+def _rf_revision() -> int:
+    """Peak-table revision riding every MFU/MBU-bearing bench line — the
+    BASELINE.md rule: an MFU claim is only comparable when the peak it was
+    divided by is versioned alongside it."""
+    from distributed_tensorflow_tpu.observability.roofline import (
+        PEAK_TABLE_REVISION)
+
+    return PEAK_TABLE_REVISION
 
 
 def cnn_train_flops_per_example(shape=(28, 28, 1), features=(32, 64),
@@ -643,6 +642,11 @@ def bench_throughput(grad_compression: str = "none",
                 (last_fit.get("health") or {}).get("anomaly_steps")}
            if health == "on" else {}),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        # round 19: the canonical spelling `analyze diff` gates higher-is-
+        # better (BASELINE.md "Roofline accounting"); "mfu" above stays for
+        # line continuity with pre-19 BENCH_*.json
+        "train_mfu": round(mfu, 4) if mfu is not None else None,
+        "roofline_peak_table_revision": _rf_revision(),
         "flops_per_example_analytic": int(flops_ex),
         "xla_flops_per_step": xla_flops,
         # train-step program memory/compile accounting (same executable
@@ -802,6 +806,16 @@ def bench_stream(steps: int = 100, grad_compression: str = "none",
             rates.append(count / (time.perf_counter() - t0))
         producer[label], _ = _median_spread(rates)
 
+    # round 19: trainer-row MFU (analytic CNN flops over the fleet peak;
+    # None on an unknown device — the honesty rule)
+    _flops_ex = cnn_train_flops_per_example(
+        shape=ds.x.shape[1:], features=_model.features, dense=_model.dense,
+        num_classes=_model.num_classes)
+    _peak = peak_flops(jax.devices()[0].device_kind)
+    _trainer_rate = trainer_fit["examples"] / trainer_fit["elapsed"]
+    _stream_mfu = (round(_trainer_rate * _flops_ex / (n * _peak), 4)
+                   if _peak else None)
+
     print(json.dumps({
         "metric": "mnist_cnn_stream_examples_per_sec",
         "unit": "examples/sec",
@@ -836,6 +850,11 @@ def bench_stream(steps: int = 100, grad_compression: str = "none",
            if health == "on" else {}),
         "trainer_examples_per_sec": round(
             trainer_fit["examples"] / trainer_fit["elapsed"], 1),
+        # round 19: MFU of the SHIPPED fit loop's row (trainer path, the
+        # rate above) — analytic model flops only, same accounting as the
+        # default line; None off-TPU (BASELINE.md "Roofline accounting")
+        "train_mfu": _stream_mfu,
+        "roofline_peak_table_revision": _rf_revision(),
         "peak_hbm_bytes_est": peak_hbm,
         "compile_total_s": compile_total_s,
         **{f"producer_{k}_rows_per_sec": round(v, 1)
@@ -1064,6 +1083,11 @@ def bench_lm(batch: int = 8, seq_len: int = 1024, vocab: int = 16384,
                    "vocab": vocab, "hidden": hidden, "layers": layers,
                    "heads": heads, "ffn": ffn, "dtype": "bfloat16"},
         "flops_per_token_analytic": int(flops_tok),
+        # round 19: the production impl's (flash) MFU under the canonical
+        # key `analyze diff` gates higher-is-better; per-impl *_mfu keys
+        # below keep the flash-vs-dense attribution
+        "train_mfu": rows["flash"]["mfu"],
+        "roofline_peak_table_revision": _rf_revision(),
         "device": device_kind,
         "n_devices": n,
         "synthetic": True,
@@ -1216,6 +1240,26 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, vocab: int = 16384,
     params_bytes = sum(a.size * a.dtype.itemsize
                        for a in jax.tree.leaves(params))
     gbps = params_bytes * steps_per_sec / 1e9
+    # round 19 MBU: achieved must-read bytes/s over the HBM peak.  The
+    # must-read set per marginal decode step is all param bytes (the
+    # ACTUAL leaf dtypes, matching the GBps figure above) plus each row's
+    # live KV — priced by the analytic cost model at the mean context of
+    # the differenced window (the marginal steps span prompt+short ..
+    # prompt+long).  None off-TPU rather than a number against a
+    # fabricated peak (BASELINE.md "Roofline accounting").
+    from distributed_tensorflow_tpu.observability.roofline import (
+        GPTCostModel, device_peaks)
+
+    _cost = GPTCostModel(vocab=vocab, hidden=hidden, layers=layers,
+                         heads=heads, ffn=ffn, max_len=max_len,
+                         kv_dtype="bfloat16",
+                         param_bytes_override=params_bytes)
+    _mid_ctx = prompt_len + (short + long) // 2
+    _step_bytes = _cost.decode_step_bytes([_mid_ctx] * batch)
+    _peaks = device_peaks(jax.devices()[0].device_kind)
+    decode_mbu = (round(_step_bytes * steps_per_sec
+                        / _peaks.hbm_bytes_per_s, 4)
+                  if _peaks is not None else None)
     print(json.dumps({
         "metric": "gpt_lm_decode_tokens_per_sec_per_chip",
         "value": round(med, 1),
@@ -1236,6 +1280,12 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, vocab: int = 16384,
         "per_token_s": round(1.0 / steps_per_sec, 6),
         "per_token_p99_s": round(exact_percentile(per_steps, 0.99), 6),
         "achieved_weight_stream_GBps": round(gbps, 1),
+        # round 19: the `analyze diff` higher-is-better gate key — the
+        # bandwidth figure above, normalized by the chip's HBM peak and
+        # widened to count the KV reads the weight-stream number omits
+        "serve_decode_mbu": decode_mbu,
+        "decode_must_read_bytes_per_step": int(_step_bytes),
+        "roofline_peak_table_revision": _rf_revision(),
         "params_millions": round(n_params / 1e6, 1),
         "params_bytes": params_bytes,
         "config": {"batch": batch, "prompt_len": prompt_len,
@@ -1394,6 +1444,12 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
     if slots % n:
         slots = ((slots + n - 1) // n) * n  # slot dim shards over 'data'
     device_kind = jax.devices()[0].device_kind
+    # round 19: every window's batcher carries a roofline built from ITS
+    # table (storage dtype/layout price the must-read bytes), so the
+    # serve lines report serve_prefill_mfu / serve_decode_mbu.  Bench
+    # lines are not parity-pinned — roofline rides unconditionally; on
+    # an unknown device the utilization keys are None, never invented.
+    from distributed_tensorflow_tpu.observability.roofline import Roofline
 
     long_len = 2 * prompt_len
     max_len = shared_len + long_len + max_new
@@ -1626,7 +1682,8 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
             batcher = ContinuousBatcher(
                 table, tracer=tracer, mode=mode, prefill_chunk=budget,
                 slo=SLOMonitor(slo_ttft, slo_itl), queue_cap=cap,
-                draft_kv=draft_kv if spec else None, draft_k=draft_k)
+                draft_kv=draft_kv if spec else None, draft_k=draft_k,
+                roofline=Roofline.for_kv(table, device_kind, n))
             summary = serve_section(batcher.run(workload(rate_scale),
                                                 on_token=deliver), n)
             if stream:         # describe ONE window, not every mode×repeat
@@ -1766,6 +1823,8 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                 rs = ReplicaSet(tables, tracer=tracer,
                                 prefill_chunk=chunk, queue_cap=queue_cap,
                                 slo=SLOMonitor(slo_ttft, slo_itl),
+                                roofline=Roofline.for_kv(
+                                    tables[0], device_kind, n),
                                 **kwargs)
                 t_w = time.perf_counter()
                 try:
@@ -1879,11 +1938,13 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
             "homogeneous": {k: med(homog, k) for k in (
                 "serve_requests_per_sec_per_chip", "serve_ttft_p95_s",
                 "serve_itl_p95_s", "serve_itl_p99_s",
-                "serve_goodput_under_slo")},
+                "serve_goodput_under_slo",
+                "serve_prefill_mfu", "serve_decode_mbu")},
             "disagg": {k: med(dis, k) for k in (
                 "serve_requests_per_sec_per_chip", "serve_ttft_p95_s",
                 "serve_itl_p95_s", "serve_itl_p99_s",
-                "serve_goodput_under_slo")},
+                "serve_goodput_under_slo",
+                "serve_prefill_mfu", "serve_decode_mbu")},
             "slo": {"ttft_s": slo_ttft, "itl_s": slo_itl,
                     "quantile": 0.99},
             "config": {"disaggregate": disagg,
@@ -2024,6 +2085,7 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                     tables, tracer=tracer, prefill_chunk=chunk,
                     queue_cap=queue_cap,
                     slo=SLOMonitor(slo_ttft, slo_itl),
+                    roofline=Roofline.for_kv(tables[0], device_kind, n),
                     draft_kvs=drafts, draft_k=draft_k,
                     watchdog_timeout_s=float(
                         env("BENCH_SERVE_WATCHDOG_S", "0")),
@@ -2063,7 +2125,8 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
             "serve_tokens_per_sec", "serve_ttft_p50_s",
             "serve_ttft_p95_s", "serve_ttft_p99_s", "serve_itl_p50_s",
             "serve_itl_p95_s", "serve_itl_p99_s",
-            "serve_goodput_under_slo", "serve_shed_rate")}
+            "serve_goodput_under_slo", "serve_shed_rate",
+            "serve_prefill_mfu", "serve_decode_mbu")}
         peak_hbm, ledger_compile_s = _serve_ledger_probe()
         rps = line["serve_requests_per_sec_per_chip"]
         chaos_fl = (chaos or {}).get("serve_fleet") or {}
@@ -2084,6 +2147,11 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
             # (one replica's table; N replicas hold N copies)
             "peak_hbm_bytes_est": peak_hbm,
             "compile_total_s": ledger_compile_s,
+            # round 19: fleet roofline section of the first clean window
+            # (per-replica tallies + the peak-table revision the MFU/MBU
+            # medians in `line` divide by)
+            "roofline_peak_table_revision": _rf_revision(),
+            "roofline": clean[0].get("roofline"),
             "serve_fleet": clean[0].get("serve_fleet"),
             # the failover gate keys come from the CHAOS window (the
             # clean window has no failovers to measure)
@@ -2350,7 +2418,12 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                   # pool utilization, and the fraction of reusable
                   # prefix blocks shared zero-copy by pointer
                   "serve_kv_blocks_in_use", "serve_kv_block_utilization",
-                  "serve_prefix_zero_copy_hit_rate")
+                  "serve_prefix_zero_copy_hit_rate",
+                  # round 19: per-phase utilization from the batcher's
+                  # roofline (analytic model flops / must-read bytes over
+                  # the peak table) — `analyze diff` gates both
+                  # higher-is-better; None on an unknown device
+                  "serve_prefill_mfu", "serve_decode_mbu")
     line = {k: med(cont, k) for k in serve_keys}
     # serving program memory/compile accounting — probed outside the
     # timed windows on a throwaway ledger-observed table
@@ -2388,6 +2461,12 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
         # production table config — the `analyze diff` memory gates
         "peak_hbm_bytes_est": peak_hbm,
         "compile_total_s": ledger_compile_s,
+        # round 19: the window roofline's provenance + per-phase tallies
+        # (model flops / must-read bytes / phase seconds) of the first
+        # production window — the MFU/MBU medians above divide by the
+        # peak-table revision stated here
+        "roofline_peak_table_revision": _rf_revision(),
+        "roofline": cont[0].get("roofline"),
         "speculative": cont[0].get("speculative"),
         "kv_baseline": kv_cmp_line,
         "slo": {"ttft_s": slo_ttft, "itl_s": slo_itl, "quantile": 0.99,

@@ -567,6 +567,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="minimum seconds between --timeline samples per "
                         "gauge group (default 0.05; 0 = record every "
                         "boundary crossing)")
+    p.add_argument("--roofline", action="store_true",
+                   help="roofline efficiency ledger (README 'Roofline & "
+                        "efficiency accounting'): analytic model FLOPs/"
+                        "bytes cost model + device peak table → train_mfu "
+                        "on the fit result, serve_prefill_mfu / "
+                        "serve_decode_mbu on the serve summary, and a "
+                        "per-compiled-program intensity/bound attribution "
+                        "table in the run report ('roofline' section; "
+                        "renders offline via `analyze roofline`).  On an "
+                        "unknown device kind utilizations report null — a "
+                        "peak is never invented.  Host-side only — off "
+                        "keeps the program set and every summary/report "
+                        "key set byte-identical")
     p.add_argument("--profile-dir", default=None,
                    help="write an XLA profiler trace here (TensorBoard/XProf)")
     p.add_argument("--dtype", default="float32",
@@ -728,6 +741,7 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         trace_path=args.trace,
         timeline=args.timeline,
         timeline_interval=args.timeline_interval,
+        roofline=args.roofline,
         profile_dir=args.profile_dir,
         dtype=args.dtype,
         watchdog_timeout=args.watchdog_timeout,

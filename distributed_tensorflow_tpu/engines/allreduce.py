@@ -122,7 +122,7 @@ class Trainer:
             on_anomaly: str = "warn",
             should_stop: Callable[[int], str | None] | None = None,
             data_state: dict | None = None,
-            straggler_detector=None, timeline=None) -> dict:
+            straggler_detector=None, timeline=None, roofline=None) -> dict:
         """Train; returns {'elapsed': seconds_around_fit, 'steps': n, ...} —
         the reference's only training metrics (reference dist_keras.py:41-49).
 
@@ -186,6 +186,15 @@ class Trainer:
         per-chunk step times the loop already measures and emits
         structured ``straggler`` trace events on outliers; its summary
         rides the result as ``stragglers``.
+        ``roofline`` (observability/roofline.Roofline, ``--roofline``):
+        analytic model-FLOPs attribution — the result gains
+        ``train_model_flops_per_step`` / ``train_achieved_flops_per_sec``
+        / ``train_mfu`` (None when the model family or device kind is
+        outside the analytic tables — a peak is never invented), and the
+        chunked drain samples a per-chunk ``achieved_flops_per_sec``
+        gauge on the ``--timeline`` series at the boundaries it already
+        syncs.  With ``roofline=None`` (default) the result key set is
+        byte-identical to round 18 — the parity pin.
 
         Steady state: host batches are staged onto the mesh ``prefetch``
         batches ahead (data/device_prefetch.py — transfer N+1 overlaps
@@ -502,6 +511,17 @@ class Trainer:
             # stall budget becomes a per-beat budget of k × timeout, so
             # the watchdog rides the chunked drain instead of forcing k=1
             watchdog.rescale(k)
+        # --roofline: analytic model FLOPs of one optimizer step (grad-
+        # accum invariant — K microbatches sum to the same tokens).  The
+        # cost model covers the GPT family only; a 2-D token batch is the
+        # shape it describes, anything else keeps the honest None.
+        rf_flops_step = None
+        if roofline is not None and roofline.cost is not None:
+            xshape = np.shape(train_ds.x)
+            if len(xshape) == 2:
+                rf_flops_step = roofline.cost.train_step_flops(
+                    bs, int(xshape[1]),
+                    grad_accum=int(getattr(eng, "grad_accum", 1) or 1))
         grad_bytes = eng.grad_collective_bytes(self.state)        # wire
         grad_bytes_raw = eng.grad_collective_bytes_raw(self.state)
         # per-device state footprint (Engine.param_bytes_per_device /
@@ -745,10 +765,15 @@ class Trainer:
                                 # --timeline: chunk step-time + prefetch
                                 # depth series at the SAME boundary the
                                 # gauges above use — no extra syncs
-                                timeline.sample_many(
-                                    {"chunk_step_time_s": dt,
-                                     "prefetch_depth": pf.queue_depth},
-                                    group="trainer")
+                                tl_vals = {"chunk_step_time_s": dt,
+                                           "prefetch_depth": pf.queue_depth}
+                                if rf_flops_step is not None and dt > 0:
+                                    # --roofline: the per-chunk achieved
+                                    # model-flops rate on the same series
+                                    tl_vals["achieved_flops_per_sec"] = \
+                                        rf_flops_step / dt
+                                timeline.sample_many(tl_vals,
+                                                     group="trainer")
                             timer.times.extend([dt] * n_chunk)
                             if straggler_detector is not None:
                                 # per-chunk average step time vs the
@@ -892,6 +917,12 @@ class Trainer:
                     except Exception:
                         pass
             raise
+        # --roofline: achieved model flops/s over the whole fit window
+        # (compile included — the honest end-to-end number; the per-chunk
+        # timeline gauge shows steady state) and its MFU against the
+        # fleet peak.  None device kind / None cost model → None MFU.
+        rf_achieved = (rf_flops_step * steps / elapsed
+                       if rf_flops_step and steps and elapsed > 0 else None)
         result = {
             "elapsed": elapsed, "steps": steps, "epochs": epochs,
             # resolved drain shape (tests/tools read these back: auto mode
@@ -976,6 +1007,15 @@ class Trainer:
             # the first chunk smears its compile over its k entries —
             # compare step_time only between runs of equal steps_per_call
             "step_time": timer.summary(),
+            # --roofline (flag-on keys only — flag-off parity is pinned):
+            # analytic model flops per step, the achieved rate, and MFU
+            # normalized over n_devices × the peak-table peak (None on an
+            # unknown device kind or a non-GPT model — never invented)
+            **({"train_model_flops_per_step": rf_flops_step,
+                "train_achieved_flops_per_sec": rf_achieved,
+                "train_mfu": roofline.mfu(rf_achieved),
+                "roofline_peak_table_revision": roofline.revision}
+               if roofline is not None else {}),
             **{f"final_{k}": v for k, v in last_metrics.items()},
         }
         self.history.append(result)

@@ -604,6 +604,19 @@ class Engine:
         bf16-f32master); the pure ``bf16`` policy halves it."""
         return self._bytes_per_device(getattr(state, "opt_state", None))
 
+    def roofline_model(self):
+        """Analytic cost model of this engine's model for ``--roofline``
+        MFU attribution (observability/roofline.py), or None for model
+        families the analytic accounting doesn't cover (CNN/MLP/BERT —
+        their MFU then honestly reports None rather than a GPT formula
+        applied to the wrong architecture).  Engines that microbatch
+        (composite/expert_parallel ``grad_accum``) need no override:
+        model FLOPs per optimizer step are grad-accum invariant."""
+        from distributed_tensorflow_tpu.observability.roofline import (
+            GPTCostModel)
+
+        return GPTCostModel.from_model(self.model)
+
     # ---------------------------------------------------------------- eval
     def eval_params(self, state: TrainState) -> PyTree:
         """Parameters to evaluate with (replicated). Subclasses with

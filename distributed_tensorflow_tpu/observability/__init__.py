@@ -39,7 +39,14 @@ fast path instead of disabling it:
   xla_stats— ProgramLedger: per-compiled-program XLA memory_analysis +
              compile wall-time (``ledger.jit`` observes a call site's
              compiles; flag off = literal ``jax.jit``), with a manifest
-             the ``analyze programs`` drift gate diffs.
+             the ``analyze programs`` drift gate diffs; round 19 adds
+             cost_analysis flops/bytes columns for roofline attribution.
+  roofline — GPTCostModel / DevicePeaks / Roofline: analytic model
+             FLOPs and must-read bytes from config alone, the device
+             peak table (unknown kind → None, never an invented peak),
+             and the MFU/MBU wiring object ``--roofline`` threads
+             through trainer, batcher, fleet and run report.  Stdlib-
+             only — ``analyze roofline`` renders offline.
   analyze  — the offline read side: span aggregation, stall summaries,
              Chrome-trace-event export (Perfetto-loadable), health
              timelines, and the run-vs-run regression diff.  Stdlib-only,
@@ -59,6 +66,9 @@ from distributed_tensorflow_tpu.observability.report import (
     build_run_report, runtime_environment, serve_section)
 from distributed_tensorflow_tpu.observability.sink import (
     SCHEMA_VERSION, AsyncJsonlSink)
+from distributed_tensorflow_tpu.observability.roofline import (
+    PEAK_TABLE_REVISION, DevicePeaks, GPTCostModel, Roofline, device_peaks,
+    program_attribution)
 from distributed_tensorflow_tpu.observability.slo import SLOMonitor
 from distributed_tensorflow_tpu.observability.timeline import (
     GaugeSeries, Timeline, sparkline)
@@ -69,18 +79,24 @@ from distributed_tensorflow_tpu.observability.xla_stats import (
 
 __all__ = [
     "AsyncJsonlSink",
+    "DevicePeaks",
+    "GPTCostModel",
     "GaugeSeries",
     "HealthConfig",
     "LogHistogram",
     "MetricsRegistry",
     "NULL_TRACER",
+    "PEAK_TABLE_REVISION",
     "ProgramLedger",
+    "Roofline",
     "SCHEMA_VERSION",
     "SLOMonitor",
     "Timeline",
     "Tracer",
     "build_run_report",
+    "device_peaks",
     "diff_manifests",
+    "program_attribution",
     "runtime_environment",
     "serve_section",
     "sparkline",

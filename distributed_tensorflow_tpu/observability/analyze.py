@@ -21,10 +21,18 @@ answers:
                            lanes grouped — from the trace file alone;
                            --json emits the exact summaries instead
   programs REPORT          the --timeline XLA program ledger: per-program
-                           memory_analysis bytes + compile seconds; with
-                           --against BASE it becomes the drift gate —
-                           exit nonzero when the program set grew or a
-                           program's temp bytes grew past --temp-threshold
+                           memory_analysis bytes + compile seconds (and
+                           the round-19 cost_analysis flops/bytes
+                           columns); with --against BASE it becomes the
+                           drift gate — exit nonzero when the program set
+                           grew or a program's temp bytes grew past
+                           --temp-threshold (flops growth warns)
+  roofline REPORT          the --roofline attribution table: per-program
+                           arithmetic intensity, compute/bandwidth bound
+                           and attainable %-of-peak, plus the run's
+                           train_mfu / serve_decode_mbu headline — from a
+                           run report or a bare manifest; --device/--dtype
+                           override the peak lookup, --json for JSON
 
 Inputs are whatever the sinks wrote: a trace JSONL (``--trace``), a metrics
 JSONL (``--metrics-path``), a result JSONL (``--result-path``), the
@@ -713,6 +721,15 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     ("serve_fleet_prefix_hit_rate", "higher"),
     ("serve_replica_seconds", "lower"),
     ("disagg_vs_homogeneous_itl_p95", "lower"),
+    # roofline utilizations (round 19; BASELINE.md "Roofline
+    # accounting"): MFU/MBU are fractions of the hardware actually
+    # achieved — THE comparable headline across configs (a rate can rise
+    # while utilization falls on a bigger device); all higher-is-better.
+    # Cross-run claims must state the peak-table revision the run report
+    # carries.
+    ("train_mfu", "higher"),
+    ("serve_decode_mbu", "higher"),
+    ("serve_prefill_mfu", "higher"),
 )
 
 
@@ -800,6 +817,11 @@ def _value_direction(report: dict[str, Any]) -> str:
     # lower-is-better (an examples/sec improvement diffed as a regression)
     if any(s in probe for s in ("per_sec", "per sec", "/sec", "/s ")):
         return "higher"
+    # utilization-valued headlines (round 19: MFU/MBU fractions of the
+    # hardware peak) are higher-is-better — checked before the time/byte
+    # classes so e.g. a "decode_mbu" metric never trips the "byte" test
+    if any(s in probe for s in ("mfu", "mbu", "utilization")):
+        return "higher"
     if any(s in probe for s in ("_ms", " ms", "ms/", "_s ", "seconds_per",
                                 "sec_per", "s/step", "latency",
                                 # byte-valued headlines (kv_bytes_per_slot
@@ -852,6 +874,80 @@ def diff_reports(base: dict[str, Any], new: dict[str, Any],
         "improvements": improvements,
         "unchanged": unchanged,
     }
+
+
+# --------------------------------------------------- roofline attribution
+
+def _cmd_roofline(args) -> int:
+    """``analyze roofline``: render the per-program roofline table —
+    arithmetic intensity, compute/bandwidth bound, attainable %-of-peak —
+    plus the run's headline utilizations, offline from a run report or a
+    bare manifest (stdlib only; the roofline module imports no jax).
+    Device kind/dtype come from the report's own roofline section (or
+    environment), overridable; an unknown kind degrades honestly —
+    intensity still renders, bound/%-of-peak stay None."""
+    from distributed_tensorflow_tpu.observability.roofline import (
+        PEAK_TABLE_REVISION, device_peaks, program_attribution,
+        ridge_point)
+
+    flat = load_report(args.report)
+    rf = flat.get("roofline")
+    rf = rf if isinstance(rf, dict) else {}
+    dev = rf.get("device") or {}
+    kind = (args.device or dev.get("device_kind")
+            or (flat.get("environment") or {}).get("device_kind"))
+    dtype = args.dtype or dev.get("dtype") or "bf16"
+    peaks = device_peaks(kind)
+    try:
+        manifest = extract_manifest(flat)
+    except ValueError:
+        manifest = {"programs": {}}
+    rows = program_attribution(manifest.get("programs", {}),
+                               peaks=peaks, dtype=dtype)
+    headline = {k: flat.get(k) for k in ("train_mfu", "serve_decode_mbu",
+                                         "serve_prefill_mfu")
+                if isinstance(flat.get(k), (int, float))}
+    out = {
+        "device_kind": kind,
+        "known_device": peaks is not None,
+        "peak_table_revision": (dev.get("peak_table_revision")
+                                or PEAK_TABLE_REVISION),
+        "dtype": dtype,
+        "ridge_flops_per_byte": ridge_point(peaks, dtype),
+        **headline,
+        "programs": rows,
+    }
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    known = "known" if peaks is not None else "UNKNOWN — no peaks"
+    ridge = out["ridge_flops_per_byte"]
+    print(f"device: {kind or '?'} ({known})  dtype={dtype}  "
+          f"peak-table rev {out['peak_table_revision']}"
+          + (f"  ridge={ridge:.1f} flops/byte" if ridge else ""))
+    if headline:
+        print("  ".join(f"{k}={v:.4f}" for k, v in headline.items()))
+    if not rows:
+        print("no programs with cost-analysis data (run with --roofline "
+              "on a backend that reports cost_analysis)")
+        return 0
+    namew = max(len(r["program"]) for r in rows)
+
+    def _fmt(v, spec, none="-"):
+        return format(v, spec) if isinstance(v, (int, float)) else none
+
+    print(f"{'program':<{namew}}  {'flops':>10}  {'bytes':>10}  "
+          f"{'flops/B':>8}  {'bound':>9}  {'%peak':>6}")
+    for r in rows:
+        frac = r["attainable_frac_of_peak"]
+        print(f"{r['program']:<{namew}}  "
+              f"{_fmt(r['flops'], '10.3g'):>10}  "
+              f"{_fmt(r['bytes_accessed'], '10.3g'):>10}  "
+              f"{_fmt(r['arithmetic_intensity'], '8.2f'):>8}  "
+              f"{r['bound'] or '-':>9}  "
+              + (f"{100 * frac:>5.1f}%" if isinstance(frac, (int, float))
+                 else f"{'-':>6}"))
+    return 0
 
 
 # ------------------------------------------------------------------- CLI
@@ -916,6 +1012,24 @@ def main(argv: list[str] | None = None) -> int:
                     help="relative temp-bytes growth that fails the gate "
                          "(default 0.10)")
 
+    rl = sub.add_parser("roofline",
+                        help="--roofline attribution: per-program "
+                             "arithmetic intensity, compute/bandwidth "
+                             "bound and attainable %-of-peak from a run "
+                             "report (or a bare program manifest)")
+    rl.add_argument("report", help="run report / summary JSON(L) with an "
+                                   "'xla' section, or a bare manifest")
+    rl.add_argument("--device", default=None, metavar="KIND",
+                    help="device kind override (default: the report's "
+                         "roofline/environment section; unknown kinds "
+                         "render intensity only — bound and %-of-peak "
+                         "honestly stay None)")
+    rl.add_argument("--dtype", default=None,
+                    help="peak dtype key (bf16|f32|int8; default: the "
+                         "report's roofline dtype, else bf16)")
+    rl.add_argument("--json", action="store_true",
+                    help="emit the table as JSON instead of text")
+
     args = p.parse_args(argv)
     if args.cmd == "spans":
         print(json.dumps(trace_summary(read_jsonl(args.trace)), indent=2))
@@ -968,6 +1082,8 @@ def main(argv: list[str] | None = None) -> int:
         # the drift gate: growth in the program set or in a program's
         # temp bytes past threshold fails CI; removals are informational
         return 1 if failed else 0
+    if args.cmd == "roofline":
+        return _cmd_roofline(args)
     # diff: 0 = no regression, 1 = regression past threshold, 2 = nothing
     # was compared (mismatched bench metrics, or inputs sharing no known
     # metric keys — e.g. an operator diffing two trace files).  A 0 on an

@@ -88,7 +88,7 @@ def serve_section(summary: dict[str, Any] | None,
 def build_run_report(fit_result: dict[str, Any], *,
                      watchdog=None, metrics_logger=None, tracer=None,
                      serve: dict[str, Any] | None = None,
-                     timeline=None, ledger=None,
+                     timeline=None, ledger=None, roofline=None,
                      ) -> dict[str, Any]:
     """Assemble the run report from the Trainer's fit result and the live
     telemetry objects.  Every argument except ``fit_result`` is optional —
@@ -251,6 +251,51 @@ def build_run_report(fit_result: dict[str, Any], *,
         report["peak_hbm_bytes_est"] = None
         report["compile_total_s"] = (round(compile_span_s, 6)
                                      if compile_span_s else None)
+
+    # --roofline section: ONLY present when a Roofline was attached —
+    # with the flag off the report key set stays byte-identical to
+    # round 18 (parity pin; note the contrast with the always-present
+    # None sections above, which predate the parity discipline).
+    # The train half echoes the Trainer's flag-gated result keys, the
+    # serve half points at the serve section's own roofline block, and
+    # `programs` is the per-compiled-program attribution table —
+    # intensity, compute/bandwidth bound, attainable fraction of peak —
+    # from the ledger manifest's cost_analysis columns.
+    if roofline is not None:
+        from distributed_tensorflow_tpu.observability.roofline import (
+            flops_crosscheck, program_attribution)
+
+        rf_train = {
+            "model_flops_per_step": fit_result.get(
+                "train_model_flops_per_step"),
+            "achieved_flops_per_sec": fit_result.get(
+                "train_achieved_flops_per_sec"),
+            "mfu": fit_result.get("train_mfu"),
+        }
+        programs = None
+        if ledger is not None:
+            manifest = report["xla"] or {}
+            programs = program_attribution(
+                manifest.get("programs", {}),
+                peaks=roofline.peaks, dtype=roofline.dtype)
+            # analytic-vs-XLA cross-check on the train step: the ratio of
+            # XLA's counted flops to the analytic model flops (None when
+            # either side is missing; ~3x is remat's signature)
+            xla_train = next(
+                (rec.get("flops")
+                 for name, rec in manifest.get("programs", {}).items()
+                 if "train" in name and rec.get("flops")), None)
+            rf_train["xla_flops_crosscheck"] = flops_crosscheck(
+                rf_train["model_flops_per_step"], xla_train)
+        report["roofline"] = {
+            "device": roofline.describe(),
+            "train": rf_train,
+            "serve": (serve or {}).get("roofline"),
+            "programs": programs,
+        }
+        # hoisted for `analyze diff`'s higher-is-better gate (the serve
+        # keys flatten from the serve section's serve_* prefix already)
+        report["train_mfu"] = fit_result.get("train_mfu")
 
     # execution environment (jax version, device kind, effective XLA
     # flags): bench/report trajectories stay attributable across

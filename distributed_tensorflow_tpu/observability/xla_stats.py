@@ -52,6 +52,27 @@ _MEM_FIELDS = (
 )
 
 
+def cost_fields(compiled) -> dict[str, Any]:
+    """Extract XLA ``cost_analysis`` flops/bytes from a compiled
+    executable — the same executable whose memory_analysis the ledger
+    already reads, at zero extra compiles.  None-tolerant: CPU backends
+    may report nothing, and rounds 19's roofline attribution treats a
+    None column as "no data", never as zero work."""
+    out: dict[str, Any] = {"flops": None, "bytes_accessed": None}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if ca is not None:
+            flops = float(ca.get("flops", 0.0) or 0.0)
+            nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+            out["flops"] = flops if flops > 0 else None
+            out["bytes_accessed"] = nbytes if nbytes > 0 else None
+    except Exception:
+        pass
+    return out
+
+
 def memory_fields(compiled) -> dict[str, int]:
     """Extract the memory-analysis byte fields from a compiled executable,
     zeros when the backend reports nothing (memory_analysis may be None
@@ -143,8 +164,10 @@ class ProgramLedger:
     def capture(self, name: str, compiled, compile_s: float = 0.0) -> None:
         """Record a compiled executable's memory analysis under ``name``
         (programs compiled elsewhere — bench's AOT train step — enter
-        here at zero extra compile cost)."""
-        self._record(name, memory_fields(compiled), compile_s)
+        here at zero extra compile cost).  Cost-analysis flops/bytes ride
+        the same executable (round 19's roofline columns)."""
+        self._record(name, {**memory_fields(compiled),
+                            **cost_fields(compiled)}, compile_s)
 
     def _record(self, name: str, mem: dict[str, int] | None,
                 compile_s: float) -> None:
@@ -154,7 +177,10 @@ class ProgramLedger:
                 rec = self._programs[name] = {
                     "compiles": 0, "compile_s": 0.0,
                     **{dst: 0 for _, dst in _MEM_FIELDS},
-                    "peak_bytes_est": 0}
+                    "peak_bytes_est": 0,
+                    # cost_analysis columns (round 19): None until a
+                    # backend reports them — None is "no data", never 0
+                    "flops": None, "bytes_accessed": None}
             rec["compiles"] += 1
             rec["compile_s"] += float(compile_s)
             if mem is not None:
@@ -162,7 +188,14 @@ class ProgramLedger:
                 # bytes — keep the max so a heterogeneous same-name
                 # program surfaces its worst case
                 for k, v in mem.items():
-                    rec[k] = max(rec[k], int(v))
+                    if v is None:
+                        continue
+                    if rec.get(k) is None:
+                        rec[k] = v if k in ("flops", "bytes_accessed") \
+                            else int(v)
+                    else:
+                        rec[k] = max(rec[k], v if k in
+                                     ("flops", "bytes_accessed") else int(v))
 
     # ------------------------------------------------------------- reading
     def programs(self) -> dict[str, dict[str, Any]]:
@@ -195,15 +228,21 @@ class ProgramLedger:
 
 
 def diff_manifests(current: dict[str, Any], baseline: dict[str, Any],
-                   temp_threshold: float = 0.10) -> list[dict[str, Any]]:
+                   temp_threshold: float = 0.10,
+                   flops_threshold: float = 0.10) -> list[dict[str, Any]]:
     """Program-set drift between two manifests (stdlib-only — analyze
     imports this logic's twin; kept here so library users gate in-process).
 
     Returns a list of findings; empty means no drift.  A finding is a
     program ADDED vs baseline, or one whose ``temp_bytes`` grew more than
     ``temp_threshold`` (relative; absolute growth when baseline is 0).
-    Removed programs are reported as informational (``severity: info``) —
-    shrinking the program set never fails the gate."""
+    FLOPs growth past ``flops_threshold`` WARNS (``severity: warn``) the
+    way temp-bytes growth fails — more model work per call is worth a
+    look but legitimate config changes move it, so it never exits the
+    gate nonzero on its own; None columns (CPU backends) compare as "no
+    data" and are skipped.  Removed programs are reported as
+    informational (``severity: info``) — shrinking the program set never
+    fails the gate."""
     cur = current.get("programs", {})
     base = baseline.get("programs", {})
     findings: list[dict[str, Any]] = []
@@ -228,6 +267,17 @@ def diff_manifests(current: dict[str, Any], baseline: dict[str, Any],
                 "threshold": temp_threshold,
                 "detail": (f"temp bytes {t_base} -> {t_cur} "
                            f"(threshold {temp_threshold:.0%})")})
+        f_cur = cur[name].get("flops")
+        f_base = base[name].get("flops")
+        if f_cur is not None and f_base is not None and f_base > 0:
+            f_rel = (float(f_cur) - float(f_base)) / float(f_base)
+            if f_rel > flops_threshold:
+                findings.append({
+                    "severity": "warn", "kind": "flops_grew", "name": name,
+                    "baseline": f_base, "current": f_cur,
+                    "relative": f_rel, "threshold": flops_threshold,
+                    "detail": (f"flops {f_base:.3g} -> {f_cur:.3g} "
+                               f"(threshold {flops_threshold:.0%})")})
     for name in sorted(set(base) - set(cur)):
         findings.append({
             "severity": "info", "kind": "program_removed", "name": name,

@@ -837,7 +837,8 @@ class ReplicaSet:
                  routing: str = "least-loaded",
                  autoscale: AutoscalePolicy | str | None = None,
                  handoff_s: float = 0.0,
-                 parallel_lanes: bool = False):
+                 parallel_lanes: bool = False,
+                 roofline=None):
         if not kvs:
             raise ValueError("ReplicaSet needs at least one SlotKVCache")
         if draft_kvs is not None and len(draft_kvs) != len(kvs):
@@ -914,6 +915,11 @@ class ReplicaSet:
         # replica threads write DISTINCT series keys, so the host-side
         # ring writes never contend on one buffer.
         self.timeline = timeline
+        # --roofline: ONE Roofline (device peaks + the analytic cost model
+        # for the replicas' shared model) handed to every batcher; each
+        # tallies its own host-side phase counters, and _summary sums
+        # them across replicas flag-gated (key-set parity when off)
+        self.roofline = roofline
         self.vocab = int(kvs[0].dm.vocab_size)
         self.draft_kvs = draft_kvs
         self._affinity_block = int(getattr(kvs[0], "prefix_block", 0) or 0)
@@ -945,7 +951,7 @@ class ReplicaSet:
                              self._replica_should_stop(r, iters)),
                 draft_kv=(draft_kvs[i] if draft_kvs is not None else None),
                 draft_k=draft_k, timeline=timeline, timeline_tag=i,
-                role=role,
+                role=role, roofline=roofline,
                 handoff_out=(self._handoff_hook(replica)
                              if role == "prefill" else None))
             self.replicas.append(replica)
@@ -972,6 +978,10 @@ class ReplicaSet:
         self._prefix_sums: dict[str, int] = {}
         self._paged_sums: dict[str, int] = {}   # zero-copy/CoW across replicas
         self._phase_sums: dict[str, float] = {}
+        # --roofline ledgers (identically empty flag-off): fleet totals of
+        # the batchers' analytic counters + the same split per replica id
+        self._rf_sums: dict[str, float] = {}
+        self._rf_replica: dict[int, dict[str, float]] = {}
         self._shed_count = 0
         self._run_summaries = 0
         # round-18 per-run ledgers (all identically zero/empty flag-off)
@@ -1536,6 +1546,15 @@ class ReplicaSet:
                                            + pg.get(k, 0))
             for k, v in (s.get("device_phase_s") or {}).items():
                 self._phase_sums[k] = self._phase_sums.get(k, 0.0) + v
+            rf = s.get("roofline")
+            if rf:
+                per = self._rf_replica.setdefault(replica.id, {})
+                for k in ("prefill_model_flops", "decode_model_flops",
+                          "decode_must_read_bytes", "prefill_s",
+                          "decode_s"):
+                    v = float(rf.get(k) or 0.0)
+                    self._rf_sums[k] = self._rf_sums.get(k, 0.0) + v
+                    per[k] = per.get(k, 0.0) + v
             self._shed_count += s.get("shed_requests") or 0
             for rid in s.get("shed_rids") or ():
                 self.journal.finalize_if_assigned(rid, replica.id, "shed")
@@ -1963,6 +1982,45 @@ class ReplicaSet:
                                            replica=r.id)
                         for r in self.replicas)), default=None)
             summary["timeline_overhead_s"] = self.timeline.overhead_s
+        if self.roofline is not None:
+            # --roofline fleet keys only when attached (flag-off parity
+            # pin).  Totals are the replica batchers' analytic counters
+            # summed; the achieved rate divides total model work by total
+            # per-replica device seconds, so the MFU/MBU headline is the
+            # MEAN utilization of a serving replica — each replica runs
+            # on the roofline's n_devices.  Unknown device kind → None.
+            rf = self.roofline
+            pre_s = self._rf_sums.get("prefill_s", 0.0)
+            dec_s = self._rf_sums.get("decode_s", 0.0)
+            pre_fps = (self._rf_sums.get("prefill_model_flops", 0.0)
+                       / pre_s if pre_s > 0 else None)
+            dec_fps = (self._rf_sums.get("decode_model_flops", 0.0)
+                       / dec_s if dec_s > 0 else None)
+            dec_bps = (self._rf_sums.get("decode_must_read_bytes", 0.0)
+                       / dec_s if dec_s > 0 else None)
+            summary["serve_prefill_mfu"] = rf.mfu(pre_fps)
+            summary["serve_decode_mbu"] = rf.mbu(dec_bps)
+            summary["roofline"] = {
+                "prefill_model_flops": self._rf_sums.get(
+                    "prefill_model_flops", 0.0),
+                "decode_model_flops": self._rf_sums.get(
+                    "decode_model_flops", 0.0),
+                "decode_must_read_bytes": self._rf_sums.get(
+                    "decode_must_read_bytes", 0.0),
+                "prefill_s": pre_s,
+                "decode_s": dec_s,
+                "prefill_achieved_flops_per_sec": pre_fps,
+                "decode_achieved_flops_per_sec": dec_fps,
+                "decode_achieved_bytes_per_sec": dec_bps,
+                "prefill_mfu": rf.mfu(pre_fps),
+                "decode_mfu": rf.mfu(dec_fps),
+                "decode_mbu": rf.mbu(dec_bps),
+                "per_replica": [
+                    {"replica": rid, **counters}
+                    for rid, counters in sorted(
+                        self._rf_replica.items())],
+                "device": rf.describe(),
+            }
         # ---- round-18 keys, each gated on its feature so the flag-off
         # summary key set stays byte-identical to round 17 (parity pin)
         if (self.roles is not None or self.autoscale is not None

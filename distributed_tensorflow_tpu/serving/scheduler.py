@@ -347,7 +347,8 @@ class ContinuousBatcher:
                  queue_cap: int = 0, should_stop=None,
                  draft_kv: SlotKVCache | None = None, draft_k: int = 4,
                  timeline=None, timeline_tag: int | None = None,
-                 role: str | None = None, handoff_out=None):
+                 role: str | None = None, handoff_out=None,
+                 roofline=None):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be continuous|static, got {mode}")
         if prefill_chunk < 0:
@@ -436,6 +437,18 @@ class ContinuousBatcher:
         # per-replica series lanes.  None = sampling fully off.
         self.timeline = timeline
         self.timeline_tag = timeline_tag
+        # `roofline` (--roofline) follows the same host-side discipline: a
+        # Roofline carrying the analytic GPTCostModel for THIS kv's model.
+        # The batcher tallies model FLOPs and must-read bytes per phase in
+        # plain Python counters at boundaries that already exist — zero
+        # device syncs, zero new programs — and the summary gains
+        # serve_prefill_mfu / serve_decode_mbu plus a roofline section
+        # ONLY when it is attached (flag-off key-set parity pin).  The
+        # draft model's work is deliberately NOT counted: MFU/MBU describe
+        # the TARGET model's efficiency, and crediting draft flops would
+        # let a wasteful draft inflate the headline (BASELINE.md).
+        self.roofline = roofline
+        self._rf_cost = (roofline.cost if roofline is not None else None)
         self.idle_polls = 0
 
     # ------------------------------------------------------------ admission
@@ -479,6 +492,15 @@ class ContinuousBatcher:
             with tracer.span("prefill", rid=req.rid, prompt_len=lp):
                 slot, first = kv.insert(req.prompt)
             self.clock.on_prefill(kv.prefill_tokens_computed - before)
+            if self._rf_cost is not None:
+                # credit only positions actually computed: a prefix-cache
+                # hit of r tokens leaves positions r..lp, whose new-token
+                # attention still spans the cached context (the start
+                # offset) — plus one LM head read sampling the first token
+                done = kv.prefill_tokens_computed - before
+                self._rf_prefill_flops += (
+                    self._rf_cost.prefill_chunk_flops(done, lp - done)
+                    + self._rf_cost.lm_head_flops)
         if hasattr(kv, "note_admission"):
             # register the paged block budget (prompt + decode growth) so
             # can_admit's outstanding ledger covers this slot's worst case
@@ -762,12 +784,19 @@ class ContinuousBatcher:
                 slot = next(iter(pending))    # FIFO admission order
                 pend = pending[slot]
                 n = min(kv.pending_tokens(slot), self.prefill_chunk)
+                start = int(kv.lengths[slot])
                 with tracer.span("prefill_chunk", rid=pend["req"].rid,
-                                 slot=slot, tokens=n,
-                                 start=int(kv.lengths[slot])):
+                                 slot=slot, tokens=n, start=start):
                     first = kv.prefill_chunk(slot, self.prefill_chunk)
                 chunks += 1
                 clock.on_prefill(n)
+                if self._rf_cost is not None:
+                    # n new positions attending over `start` cached ones;
+                    # the LM head runs once, on the FINAL chunk's sample
+                    self._rf_prefill_flops += \
+                        self._rf_cost.prefill_chunk_flops(n, start)
+                    if first is not None:
+                        self._rf_prefill_flops += self._rf_cost.lm_head_flops
                 if first is not None:
                     pending.pop(slot)
                     prefills += 1
@@ -821,6 +850,13 @@ class ContinuousBatcher:
         kv = self.kv
         k_eff = self._spec_k(live) if self.draft_kv is not None else 0
         if k_eff < 1:
+            if self._rf_cost is not None:
+                contexts = [int(kv.lengths[s]) for s in sorted(live)]
+                self._rf_decode_flops += sum(
+                    self._rf_cost.decode_flops_per_token(L)
+                    for L in contexts)
+                self._rf_decode_bytes += \
+                    self._rf_cost.decode_step_bytes(contexts)
             with self.tracer.span("decode_step", active=len(live)):
                 toks = kv.advance()
             return {slot: [int(toks[slot])] for slot in live}
@@ -855,6 +891,16 @@ class ContinuousBatcher:
         kv, draft, tracer = self.kv, self.draft_kv, self.tracer
         slots = sorted(live)
         base = {s: int(kv.lengths[s]) for s in slots}
+        if self._rf_cost is not None:
+            # TARGET verify flops only (the draft's work is never
+            # credited — see __init__); bytes are the one verify step's
+            # param + live-KV reads, identical to a width-1 decode: the
+            # verify width widens activations, not weight/KV traffic
+            self._rf_decode_flops += sum(
+                self._rf_cost.verify_flops(base[s], k_eff + 1)
+                for s in slots)
+            self._rf_decode_bytes += self._rf_cost.decode_step_bytes(
+                [base[s] for s in slots])
         block = np.zeros((kv.slots, k_eff + 1), np.int32)
         block[:, 0] = kv.tokens
         with tracer.span("draft_propose", active=len(live), k=k_eff):
@@ -926,6 +972,11 @@ class ContinuousBatcher:
         # disaggregated handoff ledger (stays zero with role=None)
         self._handoffs_out = 0
         self._handoffs_in = 0
+        # roofline tallies (stay zero with roofline=None): analytic model
+        # FLOPs per phase + the bytes decode MUST read (params + live KV)
+        self._rf_prefill_flops = 0.0
+        self._rf_decode_flops = 0.0
+        self._rf_decode_bytes = 0.0
         if self.slo is not None:
             self.slo.reset()   # one monitor measures one window
         live: dict[int, _Live] = {}
@@ -1151,4 +1202,38 @@ class ContinuousBatcher:
             summary["kv_blocks_in_use_p95"] = self.timeline.stat(
                 "kv_blocks_in_use", "p95", replica=tag)
             summary["timeline_overhead_s"] = self.timeline.overhead_s
+        if self.roofline is not None:
+            # --roofline keys ride ONLY when a Roofline is attached: the
+            # flag-off key set stays byte-identical to round 18 (parity
+            # pin).  Achieved rates divide the analytic tallies by the
+            # kv's own per-phase device seconds; on an unknown device
+            # kind mfu()/mbu() return None — never a fabricated peak.
+            rf = self.roofline
+            dphase = summary["device_phase_s"]
+            pre_s = dphase.get("prefill_s", 0.0)
+            dec_s = dphase.get("decode_s", 0.0)
+            pre_fps = (self._rf_prefill_flops / pre_s
+                       if pre_s > 0 else None)
+            dec_fps = (self._rf_decode_flops / dec_s
+                       if dec_s > 0 else None)
+            dec_bps = (self._rf_decode_bytes / dec_s
+                       if dec_s > 0 else None)
+            summary["serve_prefill_mfu"] = rf.mfu(pre_fps)
+            summary["serve_decode_mbu"] = rf.mbu(dec_bps)
+            summary["roofline"] = {
+                # analytic model work (BASELINE.md: model flops, never
+                # rematerialization; must-read bytes, never bytes moved)
+                "prefill_model_flops": self._rf_prefill_flops,
+                "decode_model_flops": self._rf_decode_flops,
+                "decode_must_read_bytes": self._rf_decode_bytes,
+                "prefill_s": pre_s,
+                "decode_s": dec_s,
+                "prefill_achieved_flops_per_sec": pre_fps,
+                "decode_achieved_flops_per_sec": dec_fps,
+                "decode_achieved_bytes_per_sec": dec_bps,
+                "prefill_mfu": rf.mfu(pre_fps),
+                "decode_mfu": rf.mfu(dec_fps),
+                "decode_mbu": rf.mbu(dec_bps),
+                "device": rf.describe(),
+            }
         return summary

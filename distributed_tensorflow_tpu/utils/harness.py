@@ -188,6 +188,16 @@ class ExperimentConfig:
                                            # sampling happens at existing
                                            # iteration boundaries, never
                                            # on a timer thread)
+    roofline: bool = False                 # analytic FLOPs/bytes cost
+                                           # model + MFU/MBU attribution
+                                           # (observability/roofline) on
+                                           # the fit result, the serve
+                                           # summary and the run report;
+                                           # arms the XLA program ledger
+                                           # for cost_analysis capture.
+                                           # Host-side only; off keeps the
+                                           # program + key sets
+                                           # byte-identical (parity pin)
     profile_dir: str | None = None         # XLA profiler trace output
     dtype: str = "float32"                 # model compute dtype; 'bfloat16'
                                            # enables mixed precision (params
@@ -1736,12 +1746,39 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
     # parity pin tests/test_timeline.py enforces).
     timeline = None
     ledger = None
+    if config.timeline or config.roofline:
+        # --roofline arms the ledger too: cost_analysis flops/bytes ride
+        # the same AOT-compiled executables memory_analysis does, and the
+        # attribution table needs them.  ledger.jit compiles the SAME
+        # programs the plain path does (the round-17 discipline), so the
+        # parity pin stays about flag-OFF byte-identity.
+        from distributed_tensorflow_tpu.observability import ProgramLedger
+
+        ledger = ProgramLedger()
     if config.timeline:
-        from distributed_tensorflow_tpu.observability import (
-            ProgramLedger, Timeline)
+        from distributed_tensorflow_tpu.observability import Timeline
 
         timeline = Timeline(interval_s=config.timeline_interval)
-        ledger = ProgramLedger()
+
+    # --roofline: device peaks (honest None off-TPU) + the engine's
+    # analytic cost model (None for non-GPT models — MFU then reports
+    # None, never a number against an invented peak), normalized over the
+    # run's total device count.  Threaded through fit, the serve window
+    # and the run report below.
+    roofline = None
+    if config.roofline:
+        from distributed_tensorflow_tpu.observability.roofline import (
+            Roofline, _dtype_key, device_peaks)
+
+        rf_devices = (n * config.seq_parallel * config.tensor_parallel
+                      * config.pipeline_parallel * config.expert_parallel)
+        rf_cost = (ex.engine.roofline_model()
+                   if hasattr(ex.engine, "roofline_model") else None)
+        roofline = Roofline(
+            device_peaks(jax.local_devices()[0].device_kind),
+            rf_devices, rf_cost,
+            _dtype_key(getattr(getattr(ex.engine, "model", None),
+                               "dtype", "float32")))
 
     # elastic lease + straggler detection (distributed_tensorflow_tpu/
     # elastic/): every checkpointed run arms the graceful SIGTERM drain —
@@ -1813,7 +1850,8 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                                                else None),
                                   data_state=resume_data_state,
                                   straggler_detector=straggler,
-                                  timeline=timeline)
+                                  timeline=timeline,
+                                  roofline=roofline)
         finally:
             if watchdog is not None:
                 watchdog.close()
@@ -1918,7 +1956,8 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
                                           test_ds, tracer, total_devices,
                                           should_stop=serve_stop,
                                           timeline=timeline,
-                                          ledger=ledger)
+                                          ledger=ledger,
+                                          roofline=roofline)
             summary["serve"] = serve_sec
             # supervisor exit policy: a serve window that lost requests
             # (unserved > 0 — lease drain, retry exhaustion, dead fleet)
@@ -1960,7 +1999,8 @@ def run(config: ExperimentConfig) -> dict[str, Any]:
         report = build_run_report(fit, watchdog=watchdog,
                                   metrics_logger=metrics_logger,
                                   tracer=tracer, serve=serve_sec,
-                                  timeline=timeline, ledger=ledger)
+                                  timeline=timeline, ledger=ledger,
+                                  roofline=roofline)
         summary["run_report"] = report
         sink.emit("run_report", **report)
         sink.emit("summary", **summary)
@@ -2367,7 +2407,7 @@ def _validate_serving(config: ExperimentConfig, ex: _Experiment,
 def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
                       test_ds, tracer, total_devices: int,
                       should_stop=None, timeline=None,
-                      ledger=None) -> dict[str, Any]:
+                      ledger=None, roofline=None) -> dict[str, Any]:
     """--serve N: run a continuous-batching serving window over the
     trained params (serving/SlotKVCache + ContinuousBatcher) and return
     the run report's ``serve`` section.
@@ -2454,6 +2494,18 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
                          paged_block=config.serve_paged_block)
     kv = SlotKVCache(ex.engine.model, params, config.serve_slots,
                      **kv_kwargs)
+    # --roofline serve half: rebuild the cost model FROM THE KV TABLE so
+    # the byte accounting reflects the layout actually serving (storage
+    # dtype, paged blocks, measured param bytes) — the train-side model
+    # knows none of that.  Device peaks / device count carry over.
+    serve_roofline = None
+    if roofline is not None:
+        from distributed_tensorflow_tpu.observability.roofline import (
+            Roofline)
+
+        serve_roofline = Roofline.for_kv(
+            kv, roofline.peaks.device_kind if roofline.peaks else None,
+            total_devices)
     draft_kv = None
     if config.serve_draft_config:
         # --serve-draft-config: speculative decoding — the draft runs its
@@ -2543,7 +2595,8 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
             queue_cap=config.serve_queue_cap, slo=slo,
             draft_kvs=draft_kvs, draft_k=config.serve_draft_k,
             watchdog_timeout_s=config.serve_watchdog_s,
-            fault_injector=injector, timeline=timeline, **fleet_kwargs)
+            fault_injector=injector, timeline=timeline,
+            roofline=serve_roofline, **fleet_kwargs)
         if config.serve_hot_swap:
             # the drill: re-install the SAME trained params after half
             # the window — proves drain + swap_generations + N-1
@@ -2569,7 +2622,8 @@ def _serve_from_state(config: ExperimentConfig, ex: _Experiment, state,
             queue_cap=config.serve_queue_cap,
             should_stop=should_stop,
             draft_kv=draft_kv, draft_k=config.serve_draft_k,
-            timeline=timeline).run(requests)
+            timeline=timeline,
+            roofline=serve_roofline).run(requests)
     return serve_section(summary, total_devices, tracer=tracer)
 
 
