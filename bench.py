@@ -1314,7 +1314,8 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                 kv_dtype: str | None = None, draft: str | None = None,
                 draft_k: int | None = None, replicas: int = 0,
                 kv_layout: str | None = None,
-                disagg: str | None = None) -> None:
+                disagg: str | None = None,
+                multi_step: int | None = None) -> None:
     """Serving throughput + latency percentiles of the continuous-batching
     engine (distributed_tensorflow_tpu/serving/) against the static-batch
     restart-per-``generate`` baseline, on the SAME synthetic open-loop
@@ -1350,7 +1351,15 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
     (BENCH_SERVE_DRAFT, 'self' or a GPT size spec) turns the production
     windows speculative (draft-k → verify-1; serve_accept_rate + the
     proposed/accepted ledger ride the line; the monolithic/static
-    baselines stay non-speculative on the same trace).  Smoke runs
+    baselines stay non-speculative on the same trace).  Round 20:
+    ``--serve-multi-step K`` (BENCH_SERVE_MULTI_STEP) runs the
+    production windows with K decode iterations fused per host dispatch
+    (the batcher's pipelined ``advance_multi`` path) plus a K=1 twin
+    window on the SAME seeded trace — the line carries
+    ``serve_host_gap_s`` / ``serve_dispatches`` and the K-vs-1
+    ``serve_tokens_per_sec`` ratio (greedy streams are bitwise
+    identical across K; only the dispatch count and host gap move).
+    Smoke runs
     shrink the workload via BENCH_SERVE_* env vars (model dims, slots,
     request count, arrival rate, chunk/pool shape) exactly like
     BENCH_PER_CHIP_BATCH."""
@@ -1438,6 +1447,19 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
     if disagg and (replicas > 1 or sweep or draft):
         raise SystemExit("--disagg is its own scenario: drop --replicas/"
                          "--sweep/--serve-draft")
+    # round 20: --serve-multi-step K (BENCH_SERVE_MULTI_STEP) — the
+    # production windows fuse K decode iterations per host dispatch and
+    # a K=1 twin window on the SAME seeded trace supplies the ratio;
+    # restricted to the default single-replica line (the fleet/disagg/
+    # sweep scenarios have their own comparison structure)
+    multi_step = multi_step or int(env("BENCH_SERVE_MULTI_STEP",
+                                       "0")) or None
+    if multi_step is not None and multi_step < 1:
+        raise SystemExit(f"--serve-multi-step must be >= 1, "
+                         f"got {multi_step}")
+    if multi_step and (replicas > 1 or sweep or disagg):
+        raise SystemExit("--serve-multi-step rides the default serve "
+                         "line: drop --replicas/--sweep/--disagg")
 
     mesh = with_backend_retry(meshlib.create_mesh)
     n = mesh.shape[meshlib.DATA_AXIS]
@@ -1637,12 +1659,29 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
             # warm already guards)
             spec_warm = ContinuousBatcher(
                 kv, mode="continuous", prefill_chunk=chunk,
-                draft_kv=draft_kv, draft_k=draft_k)
+                draft_kv=draft_kv, draft_k=draft_k,
+                # round 20: with --serve-multi-step the production
+                # windows fuse the draft's proposal loop — the fused
+                # widths must compile here, not inside a timed window
+                **({"multi_step": multi_step} if multi_step else {}))
             for m in range(2, draft_k + 3):
                 spec_warm.run([Request(rid=-m, prompt=prompts[m % 2],
                                        max_new_tokens=m,
                                        arrival_s=0.0)])
             kv.reset_prefix_cache()
+        if multi_step:
+            # round 20: the fused K-step decode scan compiles once per
+            # (shape, K) — warm BOTH widths the windows dispatch (K and
+            # the K=1 twin) with the same outside-the-timed-windows
+            # discipline as the prefill buckets above
+            for k_w in sorted({1, multi_step}):
+                slot, _ = kv.begin_insert(prompts[0])
+                while kv.prefill_chunk(slot, chunk or None) is None:
+                    pass
+                kv.advance_multi(k_w)
+                kv.evict(slot)
+            if cache_blocks:
+                kv.reset_prefix_cache()  # timed windows start cold
         note(f"warm: production {kv.compiled_programs()}, "
              f"baseline {kv_base.compiled_programs()}")
 
@@ -1664,7 +1703,7 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
         return statistics.median(vals) if vals else None
 
     def window(mode, table, budget, label, rate_scale=1.0, cap=0,
-               spec=False, sink=None):
+               spec=False, sink=None, multi=None):
         def _one(rep):
             delivered[0] = 0   # per-window count: the emitted number must
             if table.prefix_cache_blocks:
@@ -1683,7 +1722,11 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                 table, tracer=tracer, mode=mode, prefill_chunk=budget,
                 slo=SLOMonitor(slo_ttft, slo_itl), queue_cap=cap,
                 draft_kv=draft_kv if spec else None, draft_k=draft_k,
-                roofline=Roofline.for_kv(table, device_kind, n))
+                roofline=Roofline.for_kv(table, device_kind, n),
+                # flag-off windows must construct the batcher exactly
+                # as before (multi_step=None is the same thing, but the
+                # conditional keeps the call-site byte-honest)
+                **({"multi_step": multi} if multi else {}))
             summary = serve_section(batcher.run(workload(rate_scale),
                                                 on_token=deliver), n)
             if stream:         # describe ONE window, not every mode×repeat
@@ -2331,11 +2374,23 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
         # admission cap when --serve-queue-cap is set; speculative when
         # --serve-draft is; at --serve-kv-dtype storage)
         cont = measure_windows(window("continuous", kv, chunk, "serve",
-                                      cap=queue_cap, spec=True),
+                                      cap=queue_cap, spec=True,
+                                      multi=multi_step),
                                repeats, "serve", partial_errors)
         if not cont:
             raise RuntimeError(f"no serve window completed: "
                                f"{partial_errors[-1]}")
+        # round 20: the K=1 twin of the production config on the SAME
+        # seeded trace — one host dispatch per decode iteration through
+        # the same pipelined path, so the K-vs-1 tokens/sec ratio and
+        # dispatch delta isolate the fusion win (greedy streams are
+        # bitwise identical across K by construction)
+        ms1 = []
+        if multi_step and multi_step > 1:
+            ms1 = measure_windows(
+                window("continuous", kv, chunk, "serve_multi_k1",
+                       cap=queue_cap, spec=True, multi=1),
+                1, "serve_multi_k1", partial_errors)
         # monolithic/no-cache continuous on the same trace — the
         # chunked-vs-monolithic comparison (BASELINE.md "Prefill
         # accounting": same arrivals, same per-iteration token budget
@@ -2432,6 +2487,13 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
     static_rps = med(stat, "serve_requests_per_sec_per_chip")
     mono_itl95 = med(mono, "serve_itl_p95_s")
     mono_ttft50 = med(mono, "serve_ttft_p50_s")
+    # round 20: K=1 twin numbers for the fusion ratio (at K=1 the twin
+    # IS the production window — the ratio degenerates to 1.0)
+    k1_tps = k1_disp = None
+    if multi_step:
+        src = ms1 if ms1 else cont
+        k1_tps = med(src, "serve_tokens_per_sec")
+        k1_disp = med(src, "serve_dispatches")
     print(json.dumps({
         "metric": "gpt_serve_requests_per_sec_per_chip",
         "value": round(rps, 4) if rps else None,
@@ -2498,6 +2560,21 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
             else None),
         "serve_kv_layout": kv_layout,
         "paged": cont[0].get("paged"),
+        # round 20: multi-step dispatch accounting — gated on the flag
+        # so the flag-off line's key set is unchanged: fused width K,
+        # host dispatches + host gap of the production windows (the
+        # `analyze diff` lower-is-better gates), and the K-vs-1
+        # tokens/sec ratio on the SAME seeded trace (> 1 = fusing K
+        # iterations per dispatch beat one-dispatch-per-iteration)
+        **({"serve_multi_step": multi_step,
+            "serve_dispatches": med(cont, "serve_dispatches"),
+            "serve_host_gap_s": med(cont, "serve_host_gap_s"),
+            "k1_serve_tokens_per_sec": k1_tps,
+            "k1_serve_dispatches": k1_disp,
+            "multi_step_vs_k1_tokens_per_sec": (
+                round(line["serve_tokens_per_sec"] / k1_tps, 3)
+                if line["serve_tokens_per_sec"] and k1_tps else None)}
+           if multi_step else {}),
         "cached_vs_uncached_ttft_p50": (
             round(line["serve_ttft_p50_s"] / mono_ttft50, 3)
             if line["serve_ttft_p50_s"] and mono_ttft50 else None),
@@ -2527,7 +2604,8 @@ def bench_serve(stream: bool = False, trace_path: str | None = None,
                    "queue_cap": queue_cap,
                    "kv_dtype": kv.kv_dtype,
                    "kv_layout": kv_layout,
-                   "draft": draft, "draft_k": draft_k if draft else None},
+                   "draft": draft, "draft_k": draft_k if draft else None,
+                   "multi_step": multi_step},
         "device": device_kind,
         "n_devices": n,
         "synthetic": True,
@@ -2663,6 +2741,17 @@ def main() -> None:
                         "fleet against its static sizes "
                         "(serve_replica_seconds + goodput fraction of "
                         "the best static); default BENCH_SERVE_DISAGG")
+    p.add_argument("--serve-multi-step", type=int, default=None,
+                   metavar="K",
+                   help="--serve: fuse K decode iterations per host "
+                        "dispatch in the production windows (round 20 "
+                        "multi-step dispatch; default "
+                        "BENCH_SERVE_MULTI_STEP or off) — a K=1 twin "
+                        "window on the SAME seeded trace supplies the "
+                        "K-vs-1 serve_tokens_per_sec ratio, and the "
+                        "line gains serve_host_gap_s / "
+                        "serve_dispatches (greedy streams are bitwise "
+                        "identical across K)")
     p.add_argument("--steps", type=int, default=100,
                    help="--stream: measured steps per repetition (the test "
                         "suite's smoke invocation shrinks this, plus "
@@ -2753,7 +2842,8 @@ def main() -> None:
                         draft_k=args.serve_draft_k,
                         replicas=args.replicas,
                         kv_layout=args.serve_kv_layout,
-                        disagg=args.disagg)
+                        disagg=args.disagg,
+                        multi_step=args.serve_multi_step)
         elif mode == "stream":
             bench_stream(steps=max(args.steps, 1),
                          grad_compression=args.grad_compression,

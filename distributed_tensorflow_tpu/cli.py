@@ -390,7 +390,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "fit inside --serve-replicas.  The serve "
                         "section gains autoscale (scale events) + "
                         "serve_replica_seconds, the efficiency ledger "
-                        "`analyze diff` gates lower-is-better")
+                        "`analyze diff` gates lower-is-better.  "
+                        "Composes with --serve-disaggregate: the "
+                        "MIN:MAX range drives each role pool "
+                        "independently (clamped to the pool's size) "
+                        "and serve_replica_seconds splits per role")
+    p.add_argument("--serve-multi-step", type=int, default=None,
+                   metavar="K",
+                   help="--serve: fuse K decode iterations into one "
+                        "device dispatch (on-device token feedback + "
+                        "EOS/budget deactivation under lax.scan) and "
+                        "pipeline the next round's dispatch ahead of "
+                        "the current round's token materialization.  "
+                        "Greedy streams are bitwise identical to K=1; "
+                        "admissions wait at most K fused iterations "
+                        "(the staleness trade).  The serve section "
+                        "gains serve_dispatches + serve_host_gap_s "
+                        "(both gated lower-is-better by `analyze "
+                        "diff`).  Default None keeps the per-iteration "
+                        "loop, program- and key-identical to round 19")
     p.add_argument("--model-arg", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="extra model constructor field (repeatable), e.g. "
@@ -776,6 +794,7 @@ def main(argv: list[str] | None = None, *, model_fn=None,
         serve_disaggregate=args.serve_disaggregate,
         serve_routing=args.serve_routing,
         serve_autoscale=args.serve_autoscale,
+        serve_multi_step=args.serve_multi_step,
     )
     summary = run(config)  # run() itself wraps recovery when max_restarts>0
     print(json.dumps(summary))

@@ -214,6 +214,24 @@ class CausalSelfAttention(nn.Module):
                 # committed token, and rejected positions are invalidated
                 # by length bookkeeping alone
                 # (serving/kv_cache.py verify_block).
+                # MULTI-STEP CONTRACT (fused k-iteration decode,
+                # serving/kv_cache.py advance_multi): a lax.scan drives
+                # this same step k times with token feedback on device,
+                # freezing each slot's position once it deactivates
+                # (EOS/budget) — a deactivated row keeps scattering its
+                # stale token at the SAME frozen position every
+                # remaining iteration.  That rewrite is safe by the two
+                # properties already stated above: the scatter is
+                # per-(row, position) so it only ever touches the one
+                # cell past the frozen length, and validity is derived
+                # from the caller's length vector alone, so the junk
+                # cell is invisible to attention until a real token
+                # advances the length and overwrites it first.  No
+                # active-mask plumbing reaches this layer — inactive
+                # slots are a host-side fiction, which is what keeps
+                # the fused program identical to k calls of the
+                # single-step program (the bitwise-parity pin in
+                # tests/test_serving_multistep.py).
                 if pos is None:
                     raise ValueError(
                         "decode_slots=True needs per-slot positions "

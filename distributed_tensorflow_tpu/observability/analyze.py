@@ -730,6 +730,16 @@ _DIFF_METRICS: tuple[tuple[str, str], ...] = (
     ("train_mfu", "higher"),
     ("serve_decode_mbu", "higher"),
     ("serve_prefill_mfu", "higher"),
+    # multi-step decode dispatch (round 20; BASELINE.md "Dispatch
+    # accounting"): host-gap seconds — wall time the device sat idle
+    # while Python scheduled, synced D2H, and re-uploaded — is THE
+    # number fused dispatch exists to shrink (same seeded trace, same
+    # k); dispatches is its denominator, and the per-role replica-
+    # seconds split attributes the autoscaled capacity bill per pool.
+    ("serve_host_gap_s", "lower"),
+    ("serve_dispatches", "lower"),
+    ("serve_replica_seconds_prefill", "lower"),
+    ("serve_replica_seconds_decode", "lower"),
 )
 
 

@@ -838,7 +838,7 @@ class ReplicaSet:
                  autoscale: AutoscalePolicy | str | None = None,
                  handoff_s: float = 0.0,
                  parallel_lanes: bool = False,
-                 roofline=None):
+                 roofline=None, multi_step: int | None = None):
         if not kvs:
             raise ValueError("ReplicaSet needs at least one SlotKVCache")
         if draft_kvs is not None and len(draft_kvs) != len(kvs):
@@ -873,12 +873,12 @@ class ReplicaSet:
         if isinstance(autoscale, str):
             autoscale = AutoscalePolicy.parse(autoscale)
         if autoscale is not None:
-            if roles is not None:
-                raise ValueError(
-                    "autoscale drives a homogeneous fleet; combining it "
-                    "with roles (disaggregation) is not supported")
+            # with roles the policy drives each role pool independently
+            # (the MIN:MAX range is clamped per group — see _role_range);
+            # homogeneous fleets keep the exact round-18 validation
             n_max = autoscale.max_replicas or len(kvs)
-            if not autoscale.min_replicas <= n_max <= len(kvs):
+            if roles is None and \
+                    not autoscale.min_replicas <= n_max <= len(kvs):
                 raise ValueError(
                     f"autoscale range {autoscale.min_replicas}:{n_max} "
                     f"must fit in the {len(kvs)}-replica set")
@@ -902,6 +902,10 @@ class ReplicaSet:
         self.roles = roles
         self.routing = routing
         self.autoscale = autoscale
+        if multi_step is not None and int(multi_step) < 1:
+            raise ValueError(
+                f"multi_step must be >= 1, got {multi_step}")
+        self.multi_step = None if multi_step is None else int(multi_step)
         self.handoff_s = float(handoff_s)
         self.parallel_lanes = bool(parallel_lanes)
         self.slo = slo
@@ -951,7 +955,7 @@ class ReplicaSet:
                              self._replica_should_stop(r, iters)),
                 draft_kv=(draft_kvs[i] if draft_kvs is not None else None),
                 draft_k=draft_k, timeline=timeline, timeline_tag=i,
-                role=role, roofline=roofline,
+                role=role, roofline=roofline, multi_step=multi_step,
                 handoff_out=(self._handoff_hook(replica)
                              if role == "prefill" else None))
             self.replicas.append(replica)
@@ -990,10 +994,17 @@ class ReplicaSet:
         self._handoffs_delivered = 0
         self._handoffs_dropped = 0
         self._replica_seconds = 0.0
+        # per-role serving-time split (round 20): keys are replica roles
+        # (None for a homogeneous fleet) — sums to _replica_seconds
+        self._role_seconds: dict[str | None, float] = {}
         self._scale_ups = 0
         self._scale_downs = 0
         self._scale_events: list[dict[str, Any]] = []
-        self._last_scale_t: float | None = None
+        # per-role cooldown clocks (round 20): with roles each pool
+        # scales on its own queue-watermark signal and cooldown — one
+        # pool's action never starves the other's (homogeneous fleets
+        # use the single None key, exactly the round-18 behavior)
+        self._last_scale_t: dict[str | None, float] = {}
         self._slice_end: dict[int, float] = {}
         self._t_start = 0.0
         self._run_live = False
@@ -1306,36 +1317,66 @@ class ReplicaSet:
             for r in serving:
                 self._slice_end[r.id] = (self._clock_for(r).now()
                                          + pol.slice_s)
-            n_max = pol.max_replicas or len(self.replicas)
-            admitting = max(len(serving) - self._draining, 1)
-            backlog = sum(r.queue.depth(now) for r in serving)
-            # idle bookkeeping runs every tick (cooldown only gates the
-            # actions, not the timers)
-            idle = []
-            for r in serving:
-                if (r.queue.depth(now) == 0 and not r.busy
-                        and not (self._swap is not None
-                                 and self._swap.get("active") == r.id)):
-                    if r.idle_since is None:
-                        r.idle_since = now
-                    idle.append(r)
-                else:
-                    r.idle_since = None
-            if (self._last_scale_t is not None
-                    and now - self._last_scale_t < pol.cooldown_s):
+            # the decision runs PER ROLE GROUP (round 20): a disaggregated
+            # fleet's prefill and decode pools see different backlogs —
+            # prefill queues hold routed arrivals, decode queues hold
+            # handed-off streams — so each pool scales on its own
+            # watermark signal, range, and cooldown.  A homogeneous fleet
+            # has the single group None: exactly the round-18 decision.
+            for role in self._role_groups():
+                self._autoscale_tick_role(role, now)
+
+    def _role_groups(self) -> list[str | None]:
+        return ([None] if self.roles is None
+                else sorted(set(self.roles)))
+
+    def _role_range(self, role: str | None) -> tuple[int, int]:
+        """The policy's MIN:MAX clamped to the role group's size (a 1:4
+        policy over a 1P:3D split drives prefill at 1:1 and decode at
+        1:3); at least one replica per group always serves — a pool
+        scaled to zero could never observe the backlog that should wake
+        it."""
+        pol = self.autoscale
+        group = [r for r in self.replicas if r.role == role]
+        n_max = min(pol.max_replicas or len(group), len(group))
+        n_min = max(min(pol.min_replicas, n_max), 1)
+        return n_min, n_max
+
+    def _autoscale_tick_role(self, role: str | None, now: float) -> None:
+        pol = self.autoscale
+        serving = [r for r in self._serving() if r.role == role]
+        if not serving:
+            return
+        n_min, n_max = self._role_range(role)
+        admitting = max(len(serving) - self._draining, 1)
+        backlog = sum(r.queue.depth(now) for r in serving)
+        # idle bookkeeping runs every tick (cooldown only gates the
+        # actions, not the timers)
+        idle = []
+        for r in serving:
+            if (r.queue.depth(now) == 0 and not r.busy
+                    and not (self._swap is not None
+                             and self._swap.get("active") == r.id)):
+                if r.idle_since is None:
+                    r.idle_since = now
+                idle.append(r)
+            else:
+                r.idle_since = None
+        last = self._last_scale_t.get(role)
+        if last is not None and now - last < pol.cooldown_s:
+            return
+        if (backlog > pol.high_watermark * admitting
+                and len(serving) < n_max):
+            dormant = [r for r in self.replicas
+                       if r.state == "dormant" and r.role == role]
+            if dormant:
+                self._scale_up(dormant[0], now, backlog)
                 return
-            if (backlog > pol.high_watermark * admitting
-                    and len(serving) < n_max):
-                dormant = [r for r in self.replicas
-                           if r.state == "dormant"]
-                if dormant:
-                    self._scale_up(dormant[0], now, backlog)
+        if len(serving) > n_min:
+            for r in reversed(idle):   # highest id retires first
+                if now - r.idle_since >= pol.idle_s:
+                    self._scale_down(r, now)
                     return
-            if len(serving) > pol.min_replicas:
-                for r in reversed(idle):   # highest id retires first
-                    if now - r.idle_since >= pol.idle_s:
-                        self._scale_down(r, now)
-                        return
 
     def _scale_up(self, replica: _Replica, now: float,
                   backlog: int) -> None:
@@ -1346,19 +1387,25 @@ class ReplicaSet:
         replica.idle_since = None
         replica.serve_start = now
         self._scale_ups += 1
-        self._last_scale_t = now
-        self._scale_events.append(
-            {"action": "up", "replica": replica.id, "t": now,
-             "backlog": int(backlog), "serving": len(self._serving())})
+        self._last_scale_t[replica.role] = now
+        event = {"action": "up", "replica": replica.id, "t": now,
+                 "backlog": int(backlog), "serving": len(self._serving())}
+        if self.roles is not None:
+            event["role"] = replica.role
+        self._scale_events.append(event)
         self.tracer.event("scale_up", replica=replica.id,
                           backlog=int(backlog),
                           serving=len(self._serving()))
         self.tracer.counter("scale_ups")
+        # rebalance strictly WITHIN the role group: a woken decode
+        # replica must never receive un-prefilled arrivals (and vice
+        # versa) — role partitions are a routing invariant
         moved: list[Request] = []
-        for r in self._serving():
+        group = [r for r in self._serving() if r.role == replica.role]
+        for r in group:
             if r.id != replica.id:
                 moved.extend(r.queue.drain())
-        serving_ids = [r.id for r in self._serving()]
+        serving_ids = [r.id for r in group]
         for req in sorted(moved, key=lambda q: (q.arrival_s, q.rid)):
             target = self.replicas[self.journal.least_loaded(serving_ids)]
             self.journal.assign(req.rid, target.id, now, transfer=True)
@@ -1374,19 +1421,25 @@ class ReplicaSet:
         replica.state = "dormant"
         replica.idle_since = None
         if replica.serve_start is not None:
-            self._replica_seconds += max(now - replica.serve_start, 0.0)
+            span = max(now - replica.serve_start, 0.0)
+            self._replica_seconds += span
+            self._role_seconds[replica.role] = (
+                self._role_seconds.get(replica.role, 0.0) + span)
             replica.serve_start = None
         self._scale_downs += 1
-        self._last_scale_t = now
-        self._scale_events.append(
-            {"action": "down", "replica": replica.id, "t": now,
-             "serving": len(self._serving())})
+        self._last_scale_t[replica.role] = now
+        event = {"action": "down", "replica": replica.id, "t": now,
+                 "serving": len(self._serving())}
+        if self.roles is not None:
+            event["role"] = replica.role
+        self._scale_events.append(event)
         self.tracer.event("scale_down", replica=replica.id,
                           serving=len(self._serving()))
         self.tracer.counter("scale_downs")
         replica.work.set()   # the worker observes dormant and exits
         leftovers = replica.queue.drain()
-        serving_ids = [r.id for r in self._serving()]
+        serving_ids = [r.id for r in self._serving()
+                       if r.role == replica.role]
         for req in sorted(leftovers, key=lambda q: (q.arrival_s, q.rid)):
             if not serving_ids:
                 self.journal.finalize(req.rid, "lost")
@@ -1546,6 +1599,15 @@ class ReplicaSet:
                                            + pg.get(k, 0))
             for k, v in (s.get("device_phase_s") or {}).items():
                 self._phase_sums[k] = self._phase_sums.get(k, 0.0) + v
+            # multi-step dispatch ledger (keys absent flag-off): host
+            # dispatches and host-gap seconds sum across replica windows
+            if "serve_dispatches" in s:
+                self._sums["serve_dispatches"] = (
+                    self._sums.get("serve_dispatches", 0)
+                    + (s.get("serve_dispatches") or 0))
+                self._sums["serve_host_gap_s"] = (
+                    self._sums.get("serve_host_gap_s", 0.0)
+                    + (s.get("serve_host_gap_s") or 0.0))
             rf = s.get("roofline")
             if rf:
                 per = self._rf_replica.setdefault(replica.id, {})
@@ -1661,13 +1723,17 @@ class ReplicaSet:
         self._on_token = on_token
         offered = len(requests)
         if self.autoscale is not None:
-            # start at the floor; the rest of the set sleeps until queue
-            # pressure wakes it (failed replicas stay dead)
-            live = [r for r in self.replicas if r.state != "failed"]
-            for idx, replica in enumerate(live):
-                replica.state = ("serving"
-                                 if idx < self.autoscale.min_replicas
-                                 else "dormant")
+            # start at the floor, PER ROLE GROUP; the rest of the set
+            # sleeps until queue pressure wakes it (failed replicas stay
+            # dead).  Homogeneous fleets have one group (None) and keep
+            # the exact round-18 floor.
+            for role in self._role_groups():
+                n_min, _ = self._role_range(role)
+                live = [r for r in self.replicas
+                        if r.state != "failed" and r.role == role]
+                for idx, replica in enumerate(live):
+                    replica.state = ("serving" if idx < n_min
+                                     else "dormant")
         self.min_admitting_replicas = len(self._serving())
         if self.slo is not None:
             self.slo.reset()
@@ -2029,6 +2095,18 @@ class ReplicaSet:
             summary["serve_replica_seconds"] = self._replica_seconds + sum(
                 max(end - r.serve_start, 0.0) for r in self.replicas
                 if r.serve_start is not None)
+            if self.roles is not None:
+                # per-role split (round 20): the capacity bill behind a
+                # disaggregated + autoscaled fleet — which POOL the
+                # replica-seconds went to; the two keys sum to
+                # serve_replica_seconds exactly
+                for role in self._role_groups():
+                    summary[f"serve_replica_seconds_{role}"] = (
+                        self._role_seconds.get(role, 0.0) + sum(
+                            max(end - r.serve_start, 0.0)
+                            for r in self.replicas
+                            if r.role == role
+                            and r.serve_start is not None))
         if self.parallel_lanes:
             summary["serve_parallel_lanes"] = True
         if self.routing != "least-loaded":
@@ -2065,6 +2143,30 @@ class ReplicaSet:
                 "events": self._scale_events[:64],
                 "serving_replicas_final": len(self._serving()),
             }
+            if self.roles is not None:
+                # the clamped per-pool ranges the tick actually drives
+                summary["autoscale"]["per_role"] = {
+                    role: {"min_replicas": rng[0], "max_replicas": rng[1],
+                           "serving_final": sum(
+                               1 for r in self._serving()
+                               if r.role == role)}
+                    for role in self._role_groups()
+                    for rng in (self._role_range(role),)}
+        if self.multi_step is not None:
+            # multi-step keys ride ONLY flag-on (the flag-off fleet
+            # summary key set stays byte-identical to round 19): total
+            # host dispatches and host-gap seconds across every replica
+            # window, same vocabulary as the single-batcher summary
+            summary["serve_multi_step"] = self.multi_step
+            summary["serve_dispatches"] = int(
+                self._sums.get("serve_dispatches", 0))
+            summary["serve_host_gap_s"] = float(
+                self._sums.get("serve_host_gap_s", 0.0))
+            if self.roofline is not None:
+                summary["roofline"]["dispatches"] = \
+                    summary["serve_dispatches"]
+                summary["roofline"]["host_gap_s"] = \
+                    summary["serve_host_gap_s"]
         return summary
 
 

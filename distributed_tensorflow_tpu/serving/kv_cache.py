@@ -226,6 +226,7 @@ class SlotKVCache:
         self.reserved = np.zeros(self.slots, np.bool_)
         self.tokens = np.zeros(self.slots, np.int32)   # last token per slot
         self._pending: dict[int, dict] = {}            # slot → prefill state
+        self._init_multi_state()
 
         # block-aligned prefix pool (LRU over exact prefix-byte keys);
         # entries are the slot-slice KV of one block, stored at the table's
@@ -256,6 +257,30 @@ class SlotKVCache:
         self._write_block = None                       # prefix-pool restore
         self._handoff_read = None                      # disagg KV handoff
         self._handoff_write = None
+
+    def _init_multi_state(self) -> None:
+        """Shared (monolithic + paged) init for the device-resident
+        vector cache and the multi-step decode state.
+
+        ``_dev_vecs`` is the value-keyed host→device cache behind
+        ``_dev_cached``: slot vectors (tokens/lengths/mask) stay on
+        device between iterations and re-upload only when the host VALUE
+        changed — the explicit host-mirror sync point.  ``eos_tok`` /
+        ``budget`` arm the fused program's in-device deactivation
+        (-1 = no EOS, 0 = unlimited budget — the draft table's mode);
+        ``halted`` mirrors slots the device stopped advancing that the
+        scheduler has not yet evicted (occupancy ``active`` is separate);
+        ``dispatch_count`` counts every compiled-program host call."""
+        self.eos_tok = np.full(self.slots, -1, np.int32)
+        self.budget = np.zeros(self.slots, np.int32)
+        self.halted = np.zeros(self.slots, np.bool_)
+        self.dispatch_count = 0
+        self._dev_vecs: dict[str, tuple[np.ndarray, object]] = {}
+        self._multis: dict[int, object] = {}    # k → fused decode program
+        self._multi_state = None    # device carry after the last dispatch
+        self._multi_snap = None     # host view at the last dispatch
+        self._multi_pending: list[dict] = []    # in-flight rounds (FIFO)
+        self._inflight = np.zeros(self.slots, np.int32)
 
     def _place_params(self, params):
         """Param placement rule (shared by __init__ and ``swap_params``):
@@ -308,12 +333,23 @@ class SlotKVCache:
     # ------------------------------------------------------------- programs
     def _jit(self, fn, name: str, **jit_kwargs):
         """``jax.jit`` or the ledger's observed jit — the ONE dispatch
-        point deciding whether compiles are measured.  With no ledger the
-        builtin is returned untouched, so the flag-off compiled-program
-        set is byte-identical (the parity pin)."""
+        point deciding whether compiles are measured, and the ONE place
+        every compiled-program host call is counted (``dispatch_count``,
+        the denominator behind ``serve_dispatches``: the multi-step win
+        is fewer of exactly these).  With no ledger the builtin runs
+        underneath, so the flag-off compiled-program set is byte-
+        identical (the parity pin — the counting closure is host Python,
+        it compiles nothing)."""
         if self._ledger is None:
-            return jax.jit(fn, **jit_kwargs)
-        return self._ledger.jit(fn, name=name, **jit_kwargs)
+            compiled = jax.jit(fn, **jit_kwargs)
+        else:
+            compiled = self._ledger.jit(fn, name=name, **jit_kwargs)
+
+        def dispatch(*args, **kwargs):
+            self.dispatch_count += 1
+            return compiled(*args, **kwargs)
+
+        return dispatch
 
     def _sample(self, logits, rng):
         """(B, V) logits → (B,) token ids; greedy or temperature draw —
@@ -330,12 +366,16 @@ class SlotKVCache:
             # write index = current length; inactive (free) slots scatter
             # garbage into their own rows only, which the next insert's
             # prefill overwrites — validity is length-driven, so stale
-            # positions are never attended
+            # positions are never attended.  The advanced token AND
+            # length vectors are program outputs so the next iteration
+            # can consume them on device (`_dev_learn`) instead of
+            # re-uploading host mirrors.
             logits, upd = dm.apply(
                 {"params": params, "cache": cache}, tokens[:, None],
                 train=False, positions=lengths[:, None], mutable=["cache"])
             nxt = self._sample(logits[:, -1], rng).astype(tokens.dtype)
-            return upd["cache"], jnp.where(active, nxt, tokens)
+            return (upd["cache"], jnp.where(active, nxt, tokens),
+                    jnp.where(active, lengths + 1, lengths))
 
         return self._jit(step, "kv_decode_step", donate_argnums=1)
 
@@ -527,11 +567,44 @@ class SlotKVCache:
             arr = jax.device_put(arr, NamedSharding(self.mesh, P()))
         return arr
 
+    def _dev_cached(self, name: str, host, put=None):
+        """Device copy of a host slot vector, re-uploaded only when the
+        host VALUE changed since the copy was learned — the k=1 decode
+        loop, the draft table and the fused multi-step dispatch all stop
+        paying a per-iteration H2D upload for tokens/lengths/mask.  The
+        cache is value-keyed, not identity-keyed: any host-side edit
+        (admission, evict, commit_block, rewind) is caught by comparison
+        at the next dispatch, which IS the explicit host→device sync
+        point."""
+        host = np.asarray(host)
+        hit = self._dev_vecs.get(name)
+        if hit is not None and hit[0].shape == host.shape \
+                and np.array_equal(hit[0], host):
+            return hit[1]
+        dev = (self._put_vec if put is None else put)(host)
+        self._dev_vecs[name] = (host.copy(), dev)
+        return dev
+
+    def _dev_learn(self, name: str, host, dev) -> None:
+        """Adopt a program OUTPUT as the device copy for ``name``: the
+        caller updated the host mirror to the same value, so the next
+        ``_dev_cached`` hit costs zero uploads."""
+        self._dev_vecs[name] = (np.asarray(host).copy(), dev)
+
     def _next_rng(self):
         if self.greedy:
             return self._rng  # unused by the program; keep it static
         self._rng, sub = jax.random.split(self._rng)
         return sub
+
+    def _multi_rngs(self, k: int):
+        """(k,)-stacked per-iteration rng keys for a fused dispatch.
+        Greedy replicates the static key (the program never reads it);
+        sampling advances the split chain exactly as k single ``advance``
+        calls would — the parity requirement."""
+        if self.greedy:
+            return jnp.stack([self._rng] * k)
+        return jnp.stack([self._next_rng() for _ in range(k)])
 
     def _claim_slot(self, prompt, slot: int | None) -> tuple[np.ndarray,
                                                              int, int]:
@@ -551,7 +624,33 @@ class SlotKVCache:
             slot = free[0]
         elif self.active[slot] or self.reserved[slot]:
             raise RuntimeError(f"slot {slot} is active — evict it first")
+        self._reset_multi_slot(slot)
         return prompt, lp, slot
+
+    def _reset_multi_slot(self, slot: int) -> None:
+        """Clear a slot's multi-step decode state at (re)claim and evict:
+        no EOS armed, unlimited budget, not device-halted.  ``_inflight``
+        is deliberately NOT cleared — it balances dispatch (+k on the
+        dispatch mask) against drain (-k on the same mask), and a slot
+        reclaimed while a round is still outstanding must keep its
+        pending decrement (the count is a conservative upper bound on
+        outstanding device writes, which is all coverage needs)."""
+        self.eos_tok[slot] = -1
+        self.budget[slot] = 0
+        self.halted[slot] = False
+
+    def set_decode_limits(self, slot: int, eos: int | None,
+                          budget: int) -> None:
+        """Arm in-device deactivation for ``slot``: the fused multi-step
+        program stops advancing it once it emits ``eos`` (None = never)
+        or exhausts ``budget`` further emissions (0 = unlimited — the
+        draft table's mode).  Host-side bookkeeping only; the vectors
+        ride the next dispatch as value-cached operands."""
+        if budget < 0:
+            raise ValueError(f"budget must be >= 0, got {budget}")
+        self.eos_tok[slot] = -1 if eos is None else int(eos)
+        self.budget[slot] = int(budget)
+        self.halted[slot] = False
 
     def insert(self, prompt, slot: int | None = None) -> tuple[int, int]:
         """Admit a prompt into a free slot (jitted prefill-insert).
@@ -706,6 +805,7 @@ class SlotKVCache:
             slot = free[0]
         elif self.active[slot] or self.reserved[slot]:
             raise RuntimeError(f"slot {slot} is active — evict it first")
+        self._reset_multi_slot(slot)
         return slot
 
     def _check_handoff_payload(self, payload: dict, block: int) -> int:
@@ -893,16 +993,214 @@ class SlotKVCache:
                 f"active slot at length {int(live.max())} would write past "
                 f"max_len={self.max_len}; the scheduler must bound "
                 f"prompt + max_new_tokens at admission")
+        if self._multi_pending:
+            raise RuntimeError(
+                "a fused multi-step round is in flight — drain it before "
+                "a single-step advance (host mirrors lag the device)")
         t0 = time.perf_counter()
-        self.cache, nxt = self._step(
-            self.params, self.cache, self._put_vec(self.tokens),
-            self._put_vec(self.lengths),
-            self._put_vec(mask), self._next_rng())
-        nxt = np.asarray(nxt)
+        self.cache, d_nxt, d_len = self._step(
+            self.params, self.cache,
+            self._dev_cached("tokens", self.tokens),
+            self._dev_cached("lengths", self.lengths),
+            self._dev_cached("mask", mask), self._next_rng())
+        nxt = np.asarray(d_nxt)
         self._phase_s["decode_s"] += time.perf_counter() - t0
         self.lengths[mask] += 1
         self.tokens = nxt.astype(np.int32)
+        # the step's own outputs ARE the next iteration's inputs — learn
+        # them so an uninterrupted decode loop uploads nothing
+        self._dev_learn("tokens", self.tokens, d_nxt)
+        self._dev_learn("lengths", self.lengths, d_len)
         return nxt
+
+    # ------------------------------------------------- multi-step decode
+    def _multi(self, k: int):
+        """Fused k-iteration decode program (the serving twin of PR 1's
+        ``build_many_step``): one ``lax.scan`` of k decode steps with
+        token feedback, lengths, active mask and per-slot budgets carried
+        ON DEVICE, plus in-device deactivation — a slot that emits its
+        armed EOS token, exhausts its emission budget, or reaches max_len
+        leaves the carried mask and contributes nothing to later
+        iterations.  The prologue folds the host-edit merge in: per-slot
+        ``edited`` flags select the freshly-uploaded host vectors over
+        the device-carried ones, so scheduler edits between dispatches
+        (admission, evict) need no separate merge program and no D2H
+        wait.  Returns the final carry plus (k, slots) stacks of the
+        emitted tokens, the active-at-entry mask per iteration (a
+        contiguous True prefix per slot — deactivation only turns slots
+        off) and the deactivated-at flags."""
+        dm = self.dm
+        max_len = self.max_len
+
+        def multi(params, cache, d_tok, d_len, d_act, d_bud,
+                  h_tok, h_len, h_act, h_bud, edited, eos, rngs):
+            tokens = jnp.where(edited, h_tok, d_tok)
+            lengths = jnp.where(edited, h_len, d_len)
+            active = jnp.where(edited, h_act, d_act)
+            budget = jnp.where(edited, h_bud, d_bud)
+
+            def body(carry, rng):
+                cache, tokens, lengths, active, budget = carry
+                logits, upd = dm.apply(
+                    {"params": params, "cache": cache}, tokens[:, None],
+                    train=False, positions=lengths[:, None],
+                    mutable=["cache"])
+                nxt = self._sample(logits[:, -1],
+                                   rng).astype(tokens.dtype)
+                nxt = jnp.where(active, nxt, tokens)
+                nlen = jnp.where(active, lengths + 1, lengths)
+                nbud = jnp.where(active & (budget > 0),
+                                 budget - 1, budget)
+                done = active & ((nxt == eos)
+                                 | ((budget > 0) & (nbud <= 0))
+                                 | (nlen >= max_len))
+                return ((upd["cache"], nxt, nlen, active & ~done, nbud),
+                        (nxt, active, done))
+
+            carry, (toks, acts, dones) = lax.scan(
+                body, (cache, tokens, lengths, active, budget), rngs)
+            return carry, toks, acts, dones
+
+        return self._jit(multi, f"kv_decode_multi_k{k}", donate_argnums=1)
+
+    def _multi_prepare(self, mask: np.ndarray, k: int) -> tuple:
+        """Layout hook before a fused dispatch: extra program operands
+        plus writability guarantees (the paged table overrides this to
+        cover in-flight growth and snapshot the block table)."""
+        return ()
+
+    def dispatch_multi(self, k: int) -> dict:
+        """Issue one fused k-iteration decode round WITHOUT materializing
+        its results: the token/mask stacks start their D2H copy
+        asynchronously and the device carry stays resident for the next
+        round's prologue — the scheduler overlaps host work (admissions,
+        chunk prefill, delivery of the previous round) with this round's
+        device time, then ``drain_multi`` blocks only on the copy.
+        Outstanding rounds drain strictly in dispatch order (FIFO).
+        Slots the device already deactivated (``halted``) are excluded
+        from the host mask; fresh host-side edits ride as ``edited``-
+        selected uploads."""
+        if k < 1:
+            raise ValueError(f"multi-step k must be >= 1, got {k}")
+        if k not in self._multis:
+            self._multis[k] = self._multi(k)
+        mask = self.active & ~self.halted
+        extra = self._multi_prepare(mask, k)
+        self._inflight[mask] += k
+        h_tok = self.tokens.astype(np.int32)
+        h_len = self.lengths.astype(np.int32)
+        h_act = mask.astype(np.bool_)
+        h_bud = self.budget.astype(np.int32)
+        snap = self._multi_snap
+        if self._multi_state is None or snap is None:
+            # first dispatch: the host view is the only truth — the
+            # device operands are the same upload, fully selected
+            edited = np.ones(self.slots, np.bool_)
+            d_tok = self._put_vec(h_tok)
+            d_len = self._put_vec(h_len)
+            d_act = self._put_vec(h_act)
+            d_bud = self._put_vec(h_bud)
+        else:
+            # edited = host diverged from the host-view-at-last-dispatch
+            # snapshot; drain applies round deltas to BOTH sides of this
+            # comparison, so only genuine scheduler edits re-upload
+            edited = ((h_tok != snap["tokens"])
+                      | (h_len != snap["lengths"])
+                      | (h_act != snap["mask"])
+                      | (h_bud != snap["budget"]))
+            d_tok, d_len, d_act, d_bud = self._multi_state
+        t0 = time.perf_counter()
+        carry, toks, acts, dones = self._multis[k](
+            self.params, self.cache, d_tok, d_len, d_act, d_bud,
+            self._put_vec(h_tok), self._put_vec(h_len),
+            self._put_vec(h_act), self._put_vec(h_bud),
+            self._put_vec(edited),
+            self._dev_cached("eos", self.eos_tok),
+            *extra, self._multi_rngs(k))
+        self.cache = carry[0]
+        self._multi_state = tuple(carry[1:])
+        for arr in (toks, acts, dones):
+            if hasattr(arr, "copy_to_host_async"):
+                arr.copy_to_host_async()
+        self._phase_s["decode_s"] += time.perf_counter() - t0
+        self._multi_snap = {"tokens": h_tok.copy(), "lengths": h_len.copy(),
+                            "mask": h_act.copy(), "budget": h_bud.copy()}
+        handle = {"k": int(k), "mask": h_act.copy(),
+                  "tok": toks, "act": acts, "done": dones}
+        self._multi_pending.append(handle)
+        return handle
+
+    def drain_multi(self, handle: dict | None = None
+                    ) -> tuple[np.ndarray, np.ndarray]:
+        """Materialize the in-flight fused round and fold its deltas into
+        the host mirrors: lengths advance by each slot's emitted count,
+        ``tokens`` takes the last emission, deactivated slots set
+        ``halted``.  The SAME deltas land on the dispatch snapshot, so
+        the next dispatch's ``edited`` comparison sees only scheduler
+        edits.  Returns ``(toks, acts)`` — (k, slots) stacks of tokens
+        and the active-at-entry mask per iteration (``acts[:, s]`` is a
+        contiguous True prefix: ``acts.sum(0)`` emissions, the last at
+        row ``emitted-1``)."""
+        if not self._multi_pending:
+            raise RuntimeError("no fused round in flight")
+        if handle is not None and handle is not self._multi_pending[0]:
+            raise RuntimeError(
+                "fused rounds drain in dispatch order — this handle is "
+                "not the oldest outstanding round")
+        handle = self._multi_pending.pop(0)
+        k, mask = handle["k"], handle["mask"]
+        t0 = time.perf_counter()
+        toks = np.asarray(handle["tok"]).astype(np.int32)
+        acts = np.asarray(handle["act"]).astype(np.bool_)
+        dones = np.asarray(handle["done"]).astype(np.bool_)
+        self._phase_s["decode_s"] += time.perf_counter() - t0
+        self._inflight[mask] -= k
+        emitted = acts.sum(axis=0).astype(np.int32)
+        sel = emitted > 0
+        done_any = dones.any(axis=0)
+        snap = self._multi_snap
+        # slots the scheduler touched mid-flight (evict + readmit) were
+        # device-inactive the whole round — host-finish conditions ARE
+        # the in-device deactivation conditions — so ``sel`` only covers
+        # slots whose host state still describes this round's stream
+        for host, view in ((self.lengths, snap["lengths"]),):
+            host[sel] += emitted[sel]
+            view[sel] += emitted[sel]
+        last = toks[np.maximum(emitted - 1, 0), np.arange(self.slots)]
+        self.tokens[sel] = last[sel]
+        snap["tokens"][sel] = last[sel]
+        bsel = sel & (self.budget > 0)
+        self.budget[bsel] -= emitted[bsel]
+        snap["budget"][bsel] -= emitted[bsel]
+        self.halted |= done_any
+        snap["mask"] &= ~done_any
+        return toks, acts
+
+    def advance_multi(self, k: int) -> tuple[np.ndarray, np.ndarray]:
+        """Fused k decode iterations, synchronously: one host dispatch,
+        one D2H materialization — ``dispatch_multi`` + ``drain_multi``
+        back to back (the speculative draft's proposal loop and tests
+        use this; the scheduler pipeline splits the two)."""
+        self.dispatch_multi(k)
+        return self.drain_multi()
+
+    @property
+    def pending_multi(self) -> int:
+        """Outstanding (dispatched, undrained) fused rounds."""
+        return len(self._multi_pending)
+
+    def abandon_multi(self) -> None:
+        """Drop every outstanding fused round without folding its tokens
+        into the host mirrors (run()'s failure cleanup: the window's
+        results are lost anyway, but evict() must not race a half-drained
+        round's bookkeeping).  Rebalances ``_inflight`` for each dropped
+        handle and resets the device carry — the next dispatch re-uploads
+        from the host mirrors (edited = all-True)."""
+        for handle in self._multi_pending:
+            self._inflight[handle["mask"]] -= handle["k"]
+        self._multi_pending.clear()
+        self._multi_state = None
+        self._multi_snap = None
 
     # ------------------------------------------------- speculative decode
     def verify_block(self, block) -> np.ndarray:
@@ -944,7 +1242,8 @@ class SlotKVCache:
             blk = jax.device_put(blk, self._blk_sharding)
         t0 = time.perf_counter()
         self.cache, g = self._verifies[width](
-            self.params, self.cache, blk, self._put_vec(self.lengths))
+            self.params, self.cache, blk,
+            self._dev_cached("lengths", self.lengths))
         g = np.asarray(g).astype(np.int32)
         self._phase_s["decode_s"] += time.perf_counter() - t0
         return g
@@ -982,6 +1281,9 @@ class SlotKVCache:
                 f"{int(self.lengths[slot])}, asked for {length}")
         self.lengths[slot] = int(length)
         self.tokens[slot] = int(token)
+        # a rewind shrinks validity below any max_len halt the fused
+        # draft rounds may have recorded — the slot decodes again
+        self.halted[slot] = False
 
     def evict(self, slot: int) -> None:
         """Free a slot.  Pure host bookkeeping: stale K/V stays in the
@@ -992,6 +1294,7 @@ class SlotKVCache:
         self.active[slot] = False
         self.lengths[slot] = 0
         self.tokens[slot] = 0
+        self._reset_multi_slot(slot)
 
     def phase_times(self) -> dict[str, float]:
         """Cumulative host-observed seconds inside the compiled prefill
@@ -1025,7 +1328,11 @@ class SlotKVCache:
                "prefill_buckets": len(self._prefills),
                "prefill_chunk_buckets": len(self._chunks),
                "prefix_block_ops": (0 if self._read_block is None else 2),
-               "verify_widths": len(self._verifies)}
+               "verify_widths": len(self._verifies),
+               # one fused multi-step decode program per k actually
+               # dispatched (--serve-multi-step) — 0 with the flag off:
+               # the flag-off program set stays exactly the prior round's
+               "decode_multi_widths": len(self._multis)}
         # the disaggregated handoff read/write pair appears only once a
         # handoff actually ran: with the feature off the compiled set —
         # keys included — is exactly the round-17 one (the flag-off
@@ -1218,6 +1525,7 @@ class PagedSlotKVCache(SlotKVCache):
         self.reserved = np.zeros(self.slots, np.bool_)
         self.tokens = np.zeros(self.slots, np.int32)
         self._pending: dict[int, dict] = {}
+        self._init_multi_state()
 
         # ... plus the paged substrate: refcounted physical blocks, a
         # free list, per-slot logical→physical tables (host numpy; the
@@ -1326,10 +1634,12 @@ class PagedSlotKVCache(SlotKVCache):
     def _masked_bt(self, mask):
         """Device block-table snapshot with non-participating rows routed
         wholly to scratch — their garbage scatter writes can never land
-        in a live (possibly shared) block."""
+        in a live (possibly shared) block.  Value-cached like the slot
+        vectors: an unchanged table re-uploads nothing."""
         bt = np.where(np.asarray(mask, np.bool_)[:, None],
                       self.block_tables_np, np.int32(self._scratch))
-        return self._put_repl(bt.astype(np.int32))
+        return self._dev_cached("bt", bt.astype(np.int32),
+                                put=self._put_repl)
 
     # ------------------------------------------------- admission budgets
     def _block_need(self, total_len: int) -> int:
@@ -1367,7 +1677,8 @@ class PagedSlotKVCache(SlotKVCache):
                 train=False, positions=lengths[:, None],
                 block_tables=bt, mutable=["cache"])
             nxt = self._sample(logits[:, -1], rng).astype(tokens.dtype)
-            return upd["cache"], jnp.where(active, nxt, tokens)
+            return (upd["cache"], jnp.where(active, nxt, tokens),
+                    jnp.where(active, lengths + 1, lengths))
 
         return self._jit(step, "kv_paged_decode_step", donate_argnums=1)
 
@@ -1487,6 +1798,7 @@ class PagedSlotKVCache(SlotKVCache):
         self.active[slot] = False
         self.lengths[slot] = 0
         self.tokens[slot] = 0
+        self._reset_multi_slot(slot)
 
     # ------------------------------------------------------- KV handoff
     def _handoff_block(self) -> int:
@@ -1658,19 +1970,84 @@ class PagedSlotKVCache(SlotKVCache):
                 f"active slot at length {int(live.max())} would write past "
                 f"max_len={self.max_len}; the scheduler must bound "
                 f"prompt + max_new_tokens at admission")
+        if self._multi_pending:
+            raise RuntimeError(
+                "a fused multi-step round is in flight — drain it before "
+                "a single-step advance (host mirrors lag the device)")
         for slot in np.flatnonzero(mask):
             pos = int(self.lengths[slot])
             self._ensure_writable(int(slot), pos, pos + 1)
         t0 = time.perf_counter()
-        self.cache, nxt = self._step(
-            self.params, self.cache, self._put_vec(self.tokens),
-            self._put_vec(self.lengths),
-            self._put_vec(mask), self._masked_bt(mask), self._next_rng())
-        nxt = np.asarray(nxt)
+        self.cache, d_nxt, d_len = self._step(
+            self.params, self.cache,
+            self._dev_cached("tokens", self.tokens),
+            self._dev_cached("lengths", self.lengths),
+            self._dev_cached("mask", mask), self._masked_bt(mask),
+            self._next_rng())
+        nxt = np.asarray(d_nxt)
         self._phase_s["decode_s"] += time.perf_counter() - t0
         self.lengths[mask] += 1
         self.tokens = nxt.astype(np.int32)
+        self._dev_learn("tokens", self.tokens, d_nxt)
+        self._dev_learn("lengths", self.lengths, d_len)
         return nxt
+
+    # ------------------------------------------------- multi-step decode
+    def _multi(self, k: int):
+        """Paged fused k-iteration decode: the monolithic scan with the
+        masked block-table operand threaded through every step.  The
+        table is a DISPATCH-TIME snapshot: `_multi_prepare` pre-extends
+        each slot's coverage for all in-flight growth, and a slot the
+        device deactivates keeps scattering at its frozen length — into
+        its own covered block (overwritten before any read: validity is
+        length-driven) or past the snapshot's coverage, which routes to
+        the scratch block."""
+        dm = self.dm
+        max_len = self.max_len
+
+        def multi(params, cache, d_tok, d_len, d_act, d_bud,
+                  h_tok, h_len, h_act, h_bud, edited, eos, bt, rngs):
+            tokens = jnp.where(edited, h_tok, d_tok)
+            lengths = jnp.where(edited, h_len, d_len)
+            active = jnp.where(edited, h_act, d_act)
+            budget = jnp.where(edited, h_bud, d_bud)
+
+            def body(carry, rng):
+                cache, tokens, lengths, active, budget = carry
+                logits, upd = dm.apply(
+                    {"params": params, "cache": cache}, tokens[:, None],
+                    train=False, positions=lengths[:, None],
+                    block_tables=bt, mutable=["cache"])
+                nxt = self._sample(logits[:, -1],
+                                   rng).astype(tokens.dtype)
+                nxt = jnp.where(active, nxt, tokens)
+                nlen = jnp.where(active, lengths + 1, lengths)
+                nbud = jnp.where(active & (budget > 0),
+                                 budget - 1, budget)
+                done = active & ((nxt == eos)
+                                 | ((budget > 0) & (nbud <= 0))
+                                 | (nlen >= max_len))
+                return ((upd["cache"], nxt, nlen, active & ~done, nbud),
+                        (nxt, active, done))
+
+            carry, (toks, acts, dones) = lax.scan(
+                body, (cache, tokens, lengths, active, budget), rngs)
+            return carry, toks, acts, dones
+
+        return self._jit(multi, f"kv_paged_decode_multi_k{k}",
+                         donate_argnums=1)
+
+    def _multi_prepare(self, mask: np.ndarray, k: int) -> tuple:
+        """Cover every masked slot's worst-case in-flight growth —
+        already-dispatched undrained rounds (``_inflight``) plus this
+        round's k — so no fused write can land outside the slot's own
+        blocks, then snapshot the masked block table as the program's
+        extra operand."""
+        for slot in np.flatnonzero(mask):
+            start = int(self.lengths[slot])
+            end = min(start + int(self._inflight[slot]) + k, self.max_len)
+            self._ensure_writable(int(slot), start, end)
+        return (self._masked_bt(mask),)
 
     def verify_block(self, block) -> np.ndarray:
         if not self.greedy:
@@ -1700,7 +2077,8 @@ class PagedSlotKVCache(SlotKVCache):
             blk = jax.device_put(blk, self._blk_sharding)
         t0 = time.perf_counter()
         self.cache, g = self._verifies[width](
-            self.params, self.cache, blk, self._put_vec(self.lengths),
+            self.params, self.cache, blk,
+            self._dev_cached("lengths", self.lengths),
             self._masked_bt(self.active))
         g = np.asarray(g).astype(np.int32)
         self._phase_s["decode_s"] += time.perf_counter() - t0

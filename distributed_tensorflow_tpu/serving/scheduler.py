@@ -92,7 +92,8 @@ import numpy as np
 from distributed_tensorflow_tpu.observability.metrics import (
     MetricsRegistry, exact_percentile)
 from distributed_tensorflow_tpu.observability.trace import NULL_TRACER
-from distributed_tensorflow_tpu.serving.kv_cache import SlotKVCache
+from distributed_tensorflow_tpu.serving.kv_cache import (
+    SlotKVCache, SlotOverflow)
 
 
 # ------------------------------------------------------------------ clocks
@@ -348,7 +349,7 @@ class ContinuousBatcher:
                  draft_kv: SlotKVCache | None = None, draft_k: int = 4,
                  timeline=None, timeline_tag: int | None = None,
                  role: str | None = None, handoff_out=None,
-                 roofline=None):
+                 roofline=None, multi_step: int | None = None):
         if mode not in ("continuous", "static"):
             raise ValueError(f"mode must be continuous|static, got {mode}")
         if prefill_chunk < 0:
@@ -449,6 +450,21 @@ class ContinuousBatcher:
         # let a wasteful draft inflate the headline (BASELINE.md).
         self.roofline = roofline
         self._rf_cost = (roofline.cost if roofline is not None else None)
+        # --serve-multi-step k: fuse k decode iterations per host
+        # dispatch (SlotKVCache.dispatch_multi/drain_multi) and pipeline
+        # round i+1's dispatch ahead of round i's materialization —
+        # bounded admission staleness (a new arrival waits at most one
+        # k-iteration round) for k× fewer host round-trips.  None = the
+        # legacy per-iteration loop, byte-identical to round 19 (the
+        # flag-off parity pin; k=1 runs the pipeline at legacy fusion).
+        # With a draft attached the outer loop stays legacy (verify
+        # rounds need host acceptance each iteration) but the draft's
+        # proposal loop fuses through the same program.
+        if multi_step is not None and int(multi_step) < 1:
+            raise ValueError(
+                f"multi_step must be >= 1 fused decode iterations per "
+                f"dispatch, got {multi_step}")
+        self.multi_step = None if multi_step is None else int(multi_step)
         self.idle_polls = 0
 
     # ------------------------------------------------------------ admission
@@ -521,6 +537,7 @@ class ContinuousBatcher:
         dec_span = tracer.span("decode", rid=req.rid, slot=slot)
         dec_span.__enter__()
         live[slot] = _Live(req, result, req_span, dec_span, now, req_attrs)
+        self._arm_multi(slot, live[slot])
         self._draft_admit(req.prompt, slot, first)
         if self._finished(live[slot]):
             # max_new_tokens == 1 (or instant EOS): the prefill's token was
@@ -567,10 +584,25 @@ class ContinuousBatcher:
         dec_span.__enter__()
         live[slot] = _Live(req, result, pend["span"], dec_span, now,
                            pend["attrs"])
+        self._arm_multi(slot, live[slot])
         self._draft_admit(req.prompt, slot, first)
         if self._finished(live[slot]):
             self._finish(slot, live)
         return True
+
+    def _arm_multi(self, slot: int, lv: _Live) -> None:
+        """Arm the kv's in-device deactivation for a freshly-live slot
+        (multi-step mode only — the flag-off path never touches the
+        vectors): the fused rounds stop a slot the moment it emits the
+        request's EOS or exhausts its remaining token budget, so later
+        fused iterations cannot decode past the stream's end.  The
+        budget counts emissions still owed AFTER the prefill's first
+        token; a request finished by that first token never dispatches
+        (``_finished`` → ``_finish`` evicts it immediately)."""
+        if self.multi_step is None:
+            return
+        remaining = lv.req.max_new_tokens - len(lv.result.tokens)
+        self.kv.set_decode_limits(slot, lv.req.eos_id, max(remaining, 0))
 
     def _handoff(self, req: Request, slot: int, span, attrs) -> None:
         """Prefill-role completion: serialize the finished slot's KV
@@ -700,8 +732,17 @@ class ContinuousBatcher:
                on_token: Callable[[int, int], None] | None,
                ) -> tuple[int, int, int]:
         """The iteration loop under run()'s claim + cleanup guard; returns
-        (decode_iterations, prefills, prefill_chunks)."""
-        kv, tracer, clock = self.kv, self.tracer, self.clock
+        (decode_iterations, prefills, prefill_chunks).
+
+        With ``multi_step`` armed (and no draft / non-prefill role) the
+        loop is replaced by the pipelined ``_serve_multi`` — same
+        admission/shed/observe/chunk passes at the same per-iteration
+        boundaries, but decode runs as fused k-step rounds with one
+        round always in flight."""
+        if (self.multi_step is not None and self.draft_kv is None
+                and self.role != "prefill"):
+            return self._serve_multi(queue, live, pending, on_token)
+        clock = self.clock
         decode_iterations = 0
         prefills = 0
         chunks = 0
@@ -715,94 +756,12 @@ class ContinuousBatcher:
             self._check_preempt(decode_iterations, queue)
             if self._preempted is not None and not (live or pending):
                 break
-            # admission between decode iterations: continuous mode
-            # fills any free slot from the arrived queue; static mode
-            # waits for the whole table to drain first
-            can_admit = (self._preempted is None
-                         and (self.mode == "continuous"
-                              or not (live or pending)))
-            while can_admit and kv.free_slots:
-                req = queue.pop_ready(clock.now())
-                if req is None:
-                    break
-                # paged block-exhaustion gate: a free SLOT is not enough
-                # when the kv is a block pool — the request's worst-case
-                # block need (prompt + max_new_tokens, plus live slots'
-                # committed budgets) must fit the free list.  Deferral
-                # pushes the request back (FIFO by arrival is preserved:
-                # the queue re-sorts) until decode completions release
-                # blocks.  With NOTHING in flight the pool is as free as
-                # it will ever get, so deferring would busy-spin — admit
-                # and let BlockPoolExhausted surface the impossible
-                # configuration instead.
-                if (hasattr(kv, "can_admit") and (live or pending)
-                        and not kv.can_admit(
-                            int(np.asarray(req.prompt).reshape(-1)
-                                .shape[0]),
-                            req.max_new_tokens)):
-                    queue.push(req)
-                    self._block_deferrals += 1
-                    break
-                if self.prefill_chunk:
-                    self._begin_admit(req, pending)
-                else:
-                    first = self._admit(req, live)
-                    prefills += 1
-                    if first is not None and on_token is not None:
-                        on_token(req.rid, first)  # the prefill's own token
-            # bounded admission (overload mode): whatever arrived beyond
-            # the queue-depth cap after this round's admissions is shed
-            # with 429 accounting — queue wait stays bounded by
-            # construction instead of growing with offered load
-            if self.queue_cap and self._preempted is None:
-                now = clock.now()
-                # depth BEFORE shedding: the overload events must record
-                # the backlog that triggered them (post-shed depth is
-                # always == queue_cap — zero information)
-                depth = queue.depth(now)
-                for req in queue.shed_ready(now, self.queue_cap):
-                    self._shed(req, depth)
-            # queue-pressure attribution: the arrived backlog, per
-            # iteration, into the histogram the summary's
-            # queue_depth_p95 reads (+ the queue's own high watermark)
-            self._registry.record("queue_depth", queue.depth(clock.now()))
-            if self.timeline is not None:
-                # --timeline sampling at the SAME boundary: queue/slot/
-                # prefill pressure plus the kv's host-counter gauges, one
-                # throttled batch per iteration — no device syncs, no new
-                # keys or programs with the flag off
-                self.timeline.sample_many(
-                    {"queue_depth": queue.depth(clock.now()),
-                     "active_slots": len(live),
-                     "prefill_pending": len(pending),
-                     **kv.timeline_gauges()},
-                    replica=self.timeline_tag, group="batcher")
-            # at most ONE ≤budget-token chunk rides each iteration: the
-            # decode stall a filling prompt can inflict is bounded by the
-            # chunk budget, whatever the prompt length
-            if pending:
-                slot = next(iter(pending))    # FIFO admission order
-                pend = pending[slot]
-                n = min(kv.pending_tokens(slot), self.prefill_chunk)
-                start = int(kv.lengths[slot])
-                with tracer.span("prefill_chunk", rid=pend["req"].rid,
-                                 slot=slot, tokens=n, start=start):
-                    first = kv.prefill_chunk(slot, self.prefill_chunk)
-                chunks += 1
-                clock.on_prefill(n)
-                if self._rf_cost is not None:
-                    # n new positions attending over `start` cached ones;
-                    # the LM head runs once, on the FINAL chunk's sample
-                    self._rf_prefill_flops += \
-                        self._rf_cost.prefill_chunk_flops(n, start)
-                    if first is not None:
-                        self._rf_prefill_flops += self._rf_cost.lm_head_flops
-                if first is not None:
-                    pending.pop(slot)
-                    prefills += 1
-                    if self._promote(slot, pend, first, live) \
-                            and on_token is not None:
-                        on_token(pend["req"].rid, first)
+            prefills += self._admission_pass(queue, live, pending, on_token)
+            self._shed_pass(queue)
+            self._observe_pass(queue, live, pending)
+            dc, dp = self._chunk_pass(live, pending, on_token)
+            chunks += dc
+            prefills += dp
             if not live:
                 if pending:
                     continue   # keep chunking: nothing to decode yet
@@ -836,6 +795,241 @@ class ContinuousBatcher:
                         self._finish(slot, live)
                         break
         return decode_iterations, prefills, chunks
+
+    # ------------------------------------------- shared per-iteration passes
+    def _admission_pass(self, queue: RequestQueue, live: dict[int, _Live],
+                        pending: dict[int, dict],
+                        on_token: Callable[[int, int], None] | None) -> int:
+        """Admission between decode iterations → prefill count delta:
+        continuous mode fills any free slot from the arrived queue;
+        static mode waits for the whole table to drain first."""
+        kv, clock = self.kv, self.clock
+        prefills = 0
+        can_admit = (self._preempted is None
+                     and (self.mode == "continuous"
+                          or not (live or pending)))
+        while can_admit and kv.free_slots:
+            req = queue.pop_ready(clock.now())
+            if req is None:
+                break
+            # paged block-exhaustion gate: a free SLOT is not enough
+            # when the kv is a block pool — the request's worst-case
+            # block need (prompt + max_new_tokens, plus live slots'
+            # committed budgets) must fit the free list.  Deferral
+            # pushes the request back (FIFO by arrival is preserved:
+            # the queue re-sorts) until decode completions release
+            # blocks.  With NOTHING in flight the pool is as free as
+            # it will ever get, so deferring would busy-spin — admit
+            # and let BlockPoolExhausted surface the impossible
+            # configuration instead.
+            if (hasattr(kv, "can_admit") and (live or pending)
+                    and not kv.can_admit(
+                        int(np.asarray(req.prompt).reshape(-1)
+                            .shape[0]),
+                        req.max_new_tokens)):
+                queue.push(req)
+                self._block_deferrals += 1
+                break
+            if self.prefill_chunk:
+                self._begin_admit(req, pending)
+            else:
+                first = self._admit(req, live)
+                prefills += 1
+                if first is not None and on_token is not None:
+                    on_token(req.rid, first)  # the prefill's own token
+        return prefills
+
+    def _shed_pass(self, queue: RequestQueue) -> None:
+        """Bounded admission (overload mode): whatever arrived beyond the
+        queue-depth cap after this round's admissions is shed with 429
+        accounting — queue wait stays bounded by construction instead of
+        growing with offered load."""
+        if self.queue_cap and self._preempted is None:
+            now = self.clock.now()
+            # depth BEFORE shedding: the overload events must record
+            # the backlog that triggered them (post-shed depth is
+            # always == queue_cap — zero information)
+            depth = queue.depth(now)
+            for req in queue.shed_ready(now, self.queue_cap):
+                self._shed(req, depth)
+
+    def _observe_pass(self, queue: RequestQueue, live: dict[int, _Live],
+                      pending: dict[int, dict]) -> None:
+        """Queue-pressure attribution: the arrived backlog, per iteration,
+        into the histogram the summary's queue_depth_p95 reads (+ the
+        queue's own high watermark), and the --timeline sample batch at
+        the same boundary."""
+        clock = self.clock
+        self._registry.record("queue_depth", queue.depth(clock.now()))
+        if self.timeline is not None:
+            # --timeline sampling at the SAME boundary: queue/slot/
+            # prefill pressure plus the kv's host-counter gauges, one
+            # throttled batch per iteration — no device syncs, no new
+            # keys or programs with the flag off
+            self.timeline.sample_many(
+                {"queue_depth": queue.depth(clock.now()),
+                 "active_slots": len(live),
+                 "prefill_pending": len(pending),
+                 **self.kv.timeline_gauges()},
+                replica=self.timeline_tag, group="batcher")
+
+    def _chunk_pass(self, live: dict[int, _Live], pending: dict[int, dict],
+                    on_token: Callable[[int, int], None] | None,
+                    ) -> tuple[int, int]:
+        """At most ONE ≤budget-token chunk rides each iteration → (chunk,
+        prefill) count deltas: the decode stall a filling prompt can
+        inflict is bounded by the chunk budget, whatever the prompt
+        length."""
+        if not pending:
+            return 0, 0
+        kv, tracer, clock = self.kv, self.tracer, self.clock
+        chunks = 0
+        prefills = 0
+        slot = next(iter(pending))    # FIFO admission order
+        pend = pending[slot]
+        n = min(kv.pending_tokens(slot), self.prefill_chunk)
+        start = int(kv.lengths[slot])
+        with tracer.span("prefill_chunk", rid=pend["req"].rid,
+                         slot=slot, tokens=n, start=start):
+            first = kv.prefill_chunk(slot, self.prefill_chunk)
+        chunks += 1
+        clock.on_prefill(n)
+        if self._rf_cost is not None:
+            # n new positions attending over `start` cached ones;
+            # the LM head runs once, on the FINAL chunk's sample
+            self._rf_prefill_flops += \
+                self._rf_cost.prefill_chunk_flops(n, start)
+            if first is not None:
+                self._rf_prefill_flops += self._rf_cost.lm_head_flops
+        if first is not None:
+            pending.pop(slot)
+            prefills += 1
+            if self._promote(slot, pend, first, live) \
+                    and on_token is not None:
+                on_token(pend["req"].rid, first)
+        return chunks, prefills
+
+    # ------------------------------------------------- multi-step pipeline
+    def _serve_multi(self, queue: RequestQueue, live: dict[int, _Live],
+                     pending: dict[int, dict],
+                     on_token: Callable[[int, int], None] | None,
+                     ) -> tuple[int, int, int]:
+        """The --serve-multi-step iteration loop: each pipeline iteration
+        runs the same admission/shed/observe/chunk passes as the legacy
+        loop, DISPATCHES the next fused k-step round, and only then
+        DRAINS the previous round's token stack — so the device is
+        already decoding round i+1 while the host materializes round i's
+        tokens and runs scheduling (``copy_to_host_async`` at dispatch,
+        the blocking ``np.asarray`` at drain).  Exactly one round is in
+        flight at a time: admissions observed between a dispatch and its
+        drain take effect on the NEXT round (the fused program's
+        host-edit prologue folds them in), bounding admission staleness
+        at k fused iterations.  Greedy streams are bitwise identical to
+        k=1: the in-device EOS/budget deactivation mirrors
+        ``_finished``'s stop conditions exactly, and per-token delivery
+        replays the stack level by level with the same clock/ITL
+        attribution the legacy loop uses per iteration."""
+        kv, tracer = self.kv, self.tracer
+        k = self.multi_step
+        decode_iterations = 0
+        prefills = 0
+        chunks = 0
+        inflight: tuple[dict, np.ndarray] | None = None
+        while len(queue) or live or pending or inflight is not None:
+            self._check_preempt(decode_iterations, queue)
+            if self._preempted is not None \
+                    and not (live or pending or inflight is not None):
+                break
+            prefills += self._admission_pass(queue, live, pending, on_token)
+            self._shed_pass(queue)
+            self._observe_pass(queue, live, pending)
+            dc, dp = self._chunk_pass(live, pending, on_token)
+            chunks += dc
+            prefills += dp
+            handle = pre = None
+            # slots halted ON DEVICE (EOS/budget hit mid-round) never
+            # re-dispatch; if every live slot is halted there is nothing
+            # to decode — they all finish at this round's drain
+            if live and any(not kv.halted[s] for s in live):
+                pre = kv.lengths.copy()
+                with tracer.span("decode_dispatch", active=len(live), k=k):
+                    handle = kv.dispatch_multi(k)
+            if inflight is not None:
+                h, pre_prev = inflight
+                inflight = None
+                toks, acts = kv.drain_multi(h)
+                decode_iterations += self._deliver_multi(
+                    live, toks, acts, pre_prev, on_token)
+                # a live slot still halted after delivery hit the
+                # device-side stop conditions without ``_finished``
+                # agreeing — only possible when the table ran out of
+                # room (length == max_len) before the request's budget
+                for slot in sorted(live):
+                    if kv.halted[slot]:
+                        raise SlotOverflow(
+                            f"slot {slot} reached max_len={kv.max_len} "
+                            f"mid-round with "
+                            f"{live[slot].req.max_new_tokens} tokens "
+                            "requested — admission must bound "
+                            "prompt+max_new_tokens to max_len")
+            if handle is not None:
+                inflight = (handle, pre)
+                continue
+            if live or pending:
+                continue
+            nxt = queue.next_arrival()
+            if nxt is None:
+                break
+            self._idle_wait(queue, nxt,  # bounded-slice sleep/jump
+                            decode_iterations)
+        return decode_iterations, prefills, chunks
+
+    def _deliver_multi(self, live: dict[int, _Live], toks: np.ndarray,
+                       acts: np.ndarray, pre: np.ndarray,
+                       on_token: Callable[[int, int], None] | None) -> int:
+        """Replay a drained (k, slots) stack level by level as if each
+        level were one legacy decode iteration → iterations delivered.
+        Every non-empty level advances the clock once and stamps each of
+        its tokens with ``now - last_t`` — under VirtualClock this is
+        bitwise the k=1 ITL attribution; under WallClock the first level
+        of the round carries the real inter-round gap.  Levels where
+        every slot was already deactivated (EOS'd mid-round) deliver
+        nothing and don't count as iterations."""
+        kv, clock = self.kv, self.clock
+        iterations = 0
+        for j in range(acts.shape[0]):
+            if not acts[j].any():
+                continue
+            if self._rf_cost is not None:
+                # context at level j is the dispatch-time length + j
+                # committed fused steps — same per-token cost the legacy
+                # loop would have tallied at that iteration
+                contexts = [int(pre[s]) + j for s in sorted(live)
+                            if acts[j, s]]
+                if contexts:
+                    self._rf_decode_flops += sum(
+                        self._rf_cost.decode_flops_per_token(L)
+                        for L in contexts)
+                    self._rf_decode_bytes += \
+                        self._rf_cost.decode_step_bytes(contexts)
+            clock.on_decode_iteration()
+            now = clock.now()
+            iterations += 1
+            for slot in sorted(np.flatnonzero(acts[j])):
+                slot = int(slot)
+                if slot not in live:
+                    continue
+                lv = live[slot]
+                tok = int(toks[j, slot])
+                lv.result.tokens.append(tok)
+                lv.result.itl_s.append(now - lv.last_t)
+                lv.last_t = now
+                self._decode_tokens += 1
+                if on_token is not None:
+                    on_token(lv.req.rid, tok)
+                if self._finished(lv):
+                    self._finish(slot, live)
+        return iterations
 
     # ------------------------------------------------- speculative decode
     def _decode_round(self, live: dict[int, _Live]) -> dict[int, list[int]]:
@@ -904,9 +1098,20 @@ class ContinuousBatcher:
         block = np.zeros((kv.slots, k_eff + 1), np.int32)
         block[:, 0] = kv.tokens
         with tracer.span("draft_propose", active=len(live), k=k_eff):
-            for j in range(k_eff):
-                block[:, j + 1] = draft.advance()
-                self._draft_iterations += 1
+            if self.multi_step is not None and k_eff > 1:
+                # --serve-multi-step: the draft's k_eff proposal loop IS
+                # a fused multi-round (budget 0 = unlimited, no EOS — the
+                # draft never self-deactivates; _spec_k already bounds
+                # k_eff to the table's capacity), one dispatch instead of
+                # k_eff.  Token-identical to the loop below: same program
+                # body under lax.scan, same greedy feedback.
+                stack, _ = draft.advance_multi(k_eff)
+                block[:, 1:] = stack.T
+                self._draft_iterations += k_eff
+            else:
+                for j in range(k_eff):
+                    block[:, j + 1] = draft.advance()
+                    self._draft_iterations += 1
         with tracer.span("decode_step", active=len(live),
                          verify_width=k_eff + 1):
             g = kv.verify_block(block)
@@ -989,13 +1194,27 @@ class ContinuousBatcher:
         # deltas over THIS run, like the prefix-pool ledger above)
         paged_before = (self.kv.paged_stats()
                         if hasattr(self.kv, "paged_stats") else None)
+        # host-dispatch ledger (multi-step accounting): compiled-program
+        # host calls as a delta over this run, and the REAL wall clock —
+        # clock.now() may be virtual, but the host gap the multi-step
+        # pipeline exists to shrink is wall time outside the device
+        disp_before = self.kv.dispatch_count + (
+            self.draft_kv.dispatch_count if self.draft_kv is not None else 0)
         with queue.claim():
             self.clock.start()
             t_start = self.clock.now()
+            wall0 = time.perf_counter()
             try:
                 decode_iterations, prefills, chunks = self._serve(
                     queue, live, pending, on_token)
             except BaseException:
+                # a torn fused round first: host mirrors lag the device
+                # while a round is in flight, and evict() below edits
+                # those mirrors — drop the outstanding handles (their
+                # tokens are lost with the window) before touching slots
+                self.kv.abandon_multi()
+                if self.draft_kv is not None:
+                    self.draft_kv.abandon_multi()
                 # a failed window must not poison the slot table — bench
                 # windows share ONE SlotKVCache, and a leaked active slot
                 # shrinks every later window's capacity (zero free slots
@@ -1024,6 +1243,7 @@ class ContinuousBatcher:
                     elif self.kv.active[slot]:
                         self.kv.evict(slot)
                 raise
+            wall_elapsed = time.perf_counter() - wall0
             elapsed = self.clock.now() - t_start
         results = sorted(self._results, key=lambda r: r.rid)
         ttfts = [r.ttft_s for r in results]
@@ -1236,4 +1456,28 @@ class ContinuousBatcher:
                 "decode_mbu": rf.mbu(dec_bps),
                 "device": rf.describe(),
             }
+        if self.multi_step is not None:
+            # multi-step keys ride ONLY when the flag is set: the
+            # flag-off summary key set stays byte-identical to round 19
+            # (parity pin).  serve_dispatches counts compiled-program
+            # host calls (every jitted entry: prefill, decode, fused
+            # rounds, verify — the denominator the k× win divides);
+            # serve_host_gap_s is REAL wall time minus host-observed
+            # device seconds — Python scheduling + D2H sync + H2D upload,
+            # exactly what fusing k iterations amortizes (gated
+            # lower-is-better by `analyze diff`).
+            dphase = summary["device_phase_s"]
+            dispatches = (self.kv.dispatch_count
+                          + (self.draft_kv.dispatch_count
+                             if self.draft_kv is not None else 0)
+                          - disp_before)
+            summary["serve_multi_step"] = self.multi_step
+            summary["serve_dispatches"] = dispatches
+            summary["serve_host_gap_s"] = max(
+                wall_elapsed - dphase.get("prefill_s", 0.0)
+                - dphase.get("decode_s", 0.0), 0.0)
+            if self.roofline is not None:
+                summary["roofline"]["dispatches"] = dispatches
+                summary["roofline"]["host_gap_s"] = \
+                    summary["serve_host_gap_s"]
         return summary

@@ -341,6 +341,9 @@ def test_fit_async_trajectory_matches_sync(tmp_path):
                             a_mgr.restore(_template(), step=step))
 
 
+# round 20 fast-lane repair: wall-clock acceptance race (~12s) rides
+# the slow lane; the unit-level wait accounting stays fast
+@pytest.mark.slow
 def test_acceptance_async_wait_under_quarter_of_sync(tmp_path):
     """ISSUE 5 acceptance: with a deliberately slowed writer,
     ``checkpoint_wait_s`` under async mode is < 25% of the same run's
@@ -449,6 +452,9 @@ def test_cli_async_checkpoint_flag_parses():
         p.parse_args(["--async-checkpoint", "maybe"])
 
 
+# round 20 fast-lane repair: heaviest harness e2e in the suite (~22s:
+# two full runs + resume); rides the slow lane
+@pytest.mark.slow
 def test_harness_async_checkpoint_resume_roundtrip(tmp_path):
     """`--checkpoint-every` + `--resume` under the async default (fsdp
     engine — GSPMD, runs on any jax): the resumed run continues the
@@ -471,6 +477,8 @@ def test_harness_async_checkpoint_resume_roundtrip(tmp_path):
     assert mgr.latest_step() == 2 * first["steps"]
 
 
+# round 20 fast-lane repair: harness e2e flag-off variant
+@pytest.mark.slow
 def test_harness_async_checkpoint_off_is_sync(tmp_path):
     from distributed_tensorflow_tpu.utils.harness import (
         ExperimentConfig, run)

@@ -318,6 +318,9 @@ def test_collapsed_router_warns_through_engine():
     assert rep["expert_overflow_window_mean"] > 0.25
 
 
+# round 20 fast-lane repair: grad-accum parity variant —
+# test_expert_parallel_grad_accum_trains keeps the fast representative
+@pytest.mark.slow
 def test_expert_parallel_grad_accum_parity(mesh8):
     """grad_accum=2 with no capacity pressure (capacity_factor=num_experts
     → zero drops) and aux_weight=0 is pure scheduling: task grads are

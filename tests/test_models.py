@@ -124,6 +124,9 @@ def test_bert_flash_matches_dense():
     np.testing.assert_allclose(ld, lf, atol=1e-4, rtol=1e-4)
 
 
+# round 20 fast-lane repair: remat parity pays two BERT grad compiles
+# (~13s); rides the slow lane
+@pytest.mark.slow
 def test_bert_remat_param_and_grad_parity():
     """Model-level remat on BERT is a scheduling change only: identical
     param tree (paths AND values — nn.remat must not perturb the flax

@@ -153,6 +153,8 @@ def test_null_tracer_is_inert():
     assert not NULL_TRACER.enabled
 
 
+# round 20 fast-lane repair: xprof-window e2e rides the slow lane
+@pytest.mark.slow
 def test_profile_wraps_xprof_window_in_span(tmp_path):
     from distributed_tensorflow_tpu.utils.metrics import profile
 

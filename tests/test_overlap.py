@@ -232,6 +232,10 @@ def _run_steps(eng, ds, n_steps=3, k=1):
     return np.asarray(m["loss"]), jax.device_get(state.params)
 
 
+# round 20 fast-lane repair: bucket-size variants ride the slow lane;
+# test_fsdp_bucketed_none_keeps_program_untouched and the padding-tail
+# test keep the fast bucketing representatives
+@pytest.mark.slow
 def test_fsdp_bucket_zero_is_bitwise_pre_overlap(mesh8):
     """Acceptance: --grad-bucket-mb 0 --grad-accum 1 compiles the
     byte-identical pre-overlap program — trajectory bitwise equal at k=1
@@ -262,6 +266,8 @@ def test_fsdp_bucketed_none_keeps_program_untouched(mesh8):
         np.testing.assert_array_equal(a, b)
 
 
+# round 20 fast-lane repair: int8 × bucketing composition variant
+@pytest.mark.slow
 def test_fsdp_bucketed_int8_drain_parity_k1_vs_k8(mesh8):
     """Acceptance: with overlap on, k=1 vs k=8 drain parity holds (the
     rounding key derives from state.step — deterministic trajectory)."""
@@ -277,6 +283,9 @@ def test_fsdp_bucketed_int8_drain_parity_k1_vs_k8(mesh8):
         np.testing.assert_array_equal(a, b)
 
 
+# round 20 fast-lane repair: convergence variant of the int8 bucketing
+# path already pinned bitwise above
+@pytest.mark.slow
 def test_fsdp_bucketed_int8_converges_close_to_unbucketed(mesh8):
     """Acceptance: the bucketed loss trajectory matches the unbucketed
     path within the documented accumulation/quantization tolerance."""
@@ -456,6 +465,9 @@ def test_run_report_surfaces_overlap_split_and_environment():
     assert off["grad_bucket_mb"] is None
 
 
+# round 20 fast-lane repair: harness e2e variant — the probe flags are
+# also pinned by the cheaper unit tests above
+@pytest.mark.slow
 def test_harness_run_spans_probe_and_records_flags(tmp_path):
     """End-to-end --grad-bucket-mb run on this container (fsdp engine):
     the collective_overlap span/event family is emitted (unsupported

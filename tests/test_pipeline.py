@@ -290,6 +290,9 @@ def test_pipeline_tp_harness_run():
     assert np.isfinite(summary["test_loss"])
 
 
+# round 20 fast-lane repair: error-path variant that still pays a full
+# pipeline+TP compile (~9s); rides the slow lane
+@pytest.mark.slow
 def test_pipeline_tp_rejects_unannotated_models():
     from distributed_tensorflow_tpu.utils.harness import ExperimentConfig, run
 

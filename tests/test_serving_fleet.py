@@ -341,6 +341,9 @@ def test_chaos_kill_during_prefill_chunk(model_params, oracle_tokens):
     assert inj.fired[0]["site"] == "prefill"
 
 
+# round 20 fast-lane repair: the heaviest chaos sites ride the slow
+# lane — four cheaper chaos-site tests stay fast in this suite
+@pytest.mark.slow
 def test_chaos_kill_between_verify_and_commit(model_params,
                                               oracle_tokens):
     """Kill-between-verify-and-commit (speculative decoding composed):
@@ -364,6 +367,8 @@ def test_chaos_kill_between_verify_and_commit(model_params,
         == led["proposed_tokens"]
 
 
+# round 20 fast-lane repair: chaos × spec-decode composition variant
+@pytest.mark.slow
 def test_chaos_decode_site_kill_fires_under_spec_decode(model_params,
                                                         oracle_tokens):
     """`iter=K` must be able to kill a SPECULATIVE replica: its target
@@ -420,6 +425,9 @@ def test_chaos_threaded_wall_clock(model_params, oracle_tokens):
     assert s["serve_fleet"]["failovers"] == 1
 
 
+# round 20 fast-lane repair: test_zombie_late_summary_not_absorbed
+# keeps the fast zombie-fencing representative
+@pytest.mark.slow
 def test_stall_watchdog_fences_zombie(model_params, oracle_tokens):
     """A stalled replica is failed over by the supervisor's watchdog and
     FENCED, not killed: when the zombie wakes and keeps emitting, the
@@ -684,6 +692,8 @@ def test_harness_fleet_e2e_fsdp():
     assert sec["serve_goodput_under_slo_per_chip"] is not None
 
 
+@pytest.mark.slow    # round 20 fast-lane repair: the e2e
+# representative is test_harness_fleet_e2e_fsdp
 def test_harness_fleet_hot_swap_e2e_fsdp():
     """--serve-hot-swap: the drill drains + swaps replica-by-replica —
     swap_generations >= 1, never below N-1 admitting, clean policy."""
@@ -705,6 +715,7 @@ def test_harness_fleet_hot_swap_e2e_fsdp():
     assert summary["serve_exit_policy"] == 0
 
 
+@pytest.mark.slow    # round 20 fast-lane repair (see above)
 def test_harness_degraded_window_flags_exit_policy(tmp_path):
     """A serve window that loses requests (single replica, killed, no
     survivor to fail over to) must surface it: serve_exit_policy = 1 and
@@ -828,6 +839,9 @@ def test_waterfall_attempts_not_fooled_by_multi_window(model_params,
         [r for r in wf["requests"] if r["attempt"] > 1]
 
 
+# round 20 fast-lane repair: reuse variant of the fleet run path the
+# fast e2e test already drives once
+@pytest.mark.slow
 def test_replica_set_run_reuse(model_params, oracle_tokens):
     """A ReplicaSet serves window after window (the bench shape): the
     second run()'s journal is fresh, surviving replicas serve again, and

@@ -143,6 +143,9 @@ def test_paged_decode_matches_oracle_staggered(model_params):
                                       np.asarray(firsts[i][1]), str(i))
 
 
+# round 20 fast-lane repair: internal-equivalence variant (fused is
+# the production path and is oracle-pinned fast)
+@pytest.mark.slow
 def test_paged_gather_path_matches_fused(model_params):
     """paged_fused=False keeps decode on the gather+dense path (the
     bitwise-monolithic oracle in paged clothes): same greedy stream as
@@ -162,6 +165,8 @@ def test_paged_gather_path_matches_fused(model_params):
     np.testing.assert_array_equal(_oracle(model, params, p, 6), fused)
 
 
+# round 20 fast-lane repair: spec-verify × paged composition variant
+@pytest.mark.slow
 def test_paged_verify_block_parity(model_params):
     """The speculative (slots, k+1) verify over the block pool: feeding
     the committed pending token + the oracle's own continuation returns
@@ -182,6 +187,8 @@ def test_paged_verify_block_parity(model_params):
     assert int(kv.advance()[slot]) == orc[5]
 
 
+# round 20 fast-lane repair: int8 × paged composition variant
+@pytest.mark.slow
 def test_paged_int8_decode_matches_monolithic_int8(model_params):
     """int8 pools with in-kernel dequant: the paged fused stream equals
     the monolithic int8 stream (both quantize identically on write; the
@@ -409,6 +416,8 @@ def test_paged_kv_bytes_per_slot_honest(model_params):
 # ------------------------------------------------------- composed workloads
 
 
+@pytest.mark.slow    # round 20 fast-lane repair: the fast paged
+# representative is test_harness_paged_e2e + the parity suites
 def test_paged_composed_chunk_prefix_spec_int8(model_params):
     """THE parity acceptance: staggered arrivals + chunked prefill +
     prefix pool + speculative decode + int8, paged vs monolithic on the
@@ -443,6 +452,9 @@ def test_paged_composed_chunk_prefix_spec_int8(model_params):
     assert mono["serve_kv_blocks_in_use"] is None
 
 
+# round 20 fast-lane repair: mesh composition variant —
+# test_paged_on_mesh keeps the fast mesh representative
+@pytest.mark.slow
 def test_paged_composed_on_mesh(model_params, mesh8):
     """The composed workload's mesh-sharded variant: chunked + prefix +
     int8 over a slot-sharded paged table — streams match the monolithic

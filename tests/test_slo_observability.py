@@ -306,6 +306,9 @@ def test_batcher_shed_accounting_conservation(model_params):
     assert len(done) + len(s["shed_rids"]) == 10
 
 
+# round 20 fast-lane repair: overload acceptance race (~9s) rides the
+# slow lane; the bounded-admission conservation pins stay fast
+@pytest.mark.slow
 def test_overload_bounded_queue_wait_acceptance(model_params):
     """THE overload acceptance (ISSUE 13): on the same seeded trace,
     deterministic in decode-iteration time (VirtualClock), the uncapped
@@ -410,6 +413,8 @@ def test_batcher_should_stop_drains_gracefully(model_params, tmp_path):
     assert s2["completed"] == 3
 
 
+# round 20 fast-lane repair: subprocess sigterm e2e rides the slow lane
+@pytest.mark.slow
 def test_harness_sigterm_with_serve_flushes_serve_section(tmp_path):
     """Satellite (PR 9 integration): the in-process SIGTERM harness from
     tests/test_elastic.py, now with --serve — a preempted run must still
@@ -627,6 +632,8 @@ def test_load_report_flattens_goodput_keys(tmp_path):
 
 # ------------------------------------------------------------- bench sweep
 
+@pytest.mark.slow    # round 20 fast-lane repair: the sweep ladder is
+# a multi-window subprocess; CI's overload smoke covers the surface
 def test_bench_serve_sweep_smoke_emits_json(tmp_path):
     """bench --serve --sweep smoke: the arrival-rate ladder runs, the
     line carries serve_max_goodput_under_slo + the knee + the overload

@@ -226,6 +226,9 @@ def test_spec_decode_bitwise_and_fewer_iterations(model_params):
     assert kv.free_slots == [0, 1]
 
 
+# round 20 fast-lane repair: robustness variant —
+# test_spec_decode_bitwise_and_fewer_iterations keeps the fast core pin
+@pytest.mark.slow
 def test_spec_decode_random_draft_still_bitwise(model_params,
                                                 draft_params):
     """Parity holds for ANY draft: a small independently-initialized
@@ -276,6 +279,8 @@ def test_accept_accounting_conservation(model_params, draft_params):
     assert spec["draft_iterations"] > 0
 
 
+# round 20 fast-lane repair: four-feature composition variant
+@pytest.mark.slow
 def test_spec_composes_with_chunk_prefix_cap_slo(model_params):
     """Spec decode under the WHOLE round-10/13 surface at once — chunked
     prefill, prefix pool, bounded admission, SLO monitor: completed
@@ -496,6 +501,9 @@ def test_int8_kv_full_scheduler_workload(model_params):
             np.asarray(res8["results"][i].tokens), str(i))
 
 
+# round 20 fast-lane repair: int8 composition variant —
+# test_int8_kv_matches_oracle_greedy keeps the fast int8 pin
+@pytest.mark.slow
 def test_int8_kv_composes_with_chunk_and_prefix(model_params):
     """Chunked prefill + the prefix pool over an int8 table: pooled
     blocks byte-copy the int8 payload AND its scale leaves (the 3-dim
@@ -544,6 +552,8 @@ def test_int8_kv_on_mesh(model_params, mesh8):
     np.testing.assert_array_equal(_oracle(model, params, p, 4), got)
 
 
+# round 20 fast-lane repair: spec × int8 composition variant
+@pytest.mark.slow
 def test_spec_decode_over_int8_table(model_params):
     """Both round-14 flags at once: the draft speculates over an int8
     target table — the verify is exact AGAINST THAT TABLE's decode, so
@@ -670,6 +680,8 @@ def test_harness_spec_decode_e2e():
             == spec["proposed_tokens"])
 
 
+@pytest.mark.slow    # round 20 fast-lane repair: the e2e
+# representative is test_harness_spec_decode_e2e
 def test_harness_spec_decode_sized_draft_e2e():
     """A size-spec draft ('hidden=16,layers=1'): fresh-initialized from
     the seed, runs the same window — accept rate is whatever it is, but
@@ -693,6 +705,7 @@ def test_harness_spec_decode_sized_draft_e2e():
     assert 0.0 <= sec["serve_accept_rate"] <= 1.0
 
 
+@pytest.mark.slow    # round 20 fast-lane repair (see above)
 def test_harness_int8_kv_e2e():
     """--serve-kv-dtype int8 through the harness: dtype + bytes in the
     serve section, at 2× the slots of the bf16 run (the capacity

@@ -521,6 +521,10 @@ def test_mnist_cnn_sync_parity_steps_per_call(mesh8):
 
 # ------------------------------------------------------- bench harness smoke
 
+# round 20 fast-lane repair: heaviest bench-subprocess smoke (~33s)
+# rides the slow lane; test_serving's bench --serve smoke keeps the
+# one fast bench-subprocess representative
+@pytest.mark.slow
 def test_bench_stream_smoke_emits_json():
     """`bench.py --stream` must emit ONE parsable JSON line whatever the
     backend state (a real measurement on capable hosts, a structured skip

@@ -532,6 +532,9 @@ def test_spec_decode_same_flops_fewer_bytes(model_params):
         < base["roofline"]["decode_must_read_bytes"]
 
 
+# round 20 fast-lane repair: fleet composition variant — the
+# single-replica exact accounting pins stay fast
+@pytest.mark.slow
 def test_fleet_aggregation_and_parity(model_params):
     """ReplicaSet folds window tallies into fleet totals + a per-replica
     breakdown; without --roofline the fleet summary keeps round-18 keys."""
